@@ -1,0 +1,173 @@
+"""Machine configuration for primesim_tpu.
+
+TPU-native replacement for the reference's XML config layer (SURVEY.md §2 #11:
+`XmlParser` producing `XmlSim`/`XmlCore`/`XmlCache`/`XmlNetwork` struct trees).
+Typed dataclasses are the source of truth; `primesim_tpu.config.xml_compat`
+loads reference-schema XML files into these for A/B parity runs.
+
+All latencies are integer cycles. All geometry fields that index arrays are
+powers of two so the vectorized engine can use mask arithmetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Sequence
+
+
+def _is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+@dataclass(frozen=True)
+class CacheConfig:
+    """Geometry + latency of one cache level (private L1 or one LLC bank)."""
+
+    size: int  # bytes (per core for L1, per bank for LLC)
+    ways: int
+    line: int  # line size, bytes
+    latency: int  # hit/lookup latency, cycles
+
+    @property
+    def sets(self) -> int:
+        s = self.size // (self.ways * self.line)
+        return s
+
+    def validate(self, name: str) -> None:
+        if not _is_pow2(self.line):
+            raise ValueError(f"{name}.line must be a power of two, got {self.line}")
+        if self.size % (self.ways * self.line) != 0:
+            raise ValueError(f"{name}.size not divisible by ways*line")
+        if not _is_pow2(self.sets):
+            raise ValueError(f"{name}: sets={self.sets} must be a power of two")
+        if self.latency < 0:
+            raise ValueError(f"{name}.latency must be >= 0")
+
+
+@dataclass(frozen=True)
+class CoreConfig:
+    """In-order core timing model (SURVEY.md §2 #2: CoreManager).
+
+    `cpi` is the cycles-per-instruction for non-memory instructions. A
+    heterogeneous (big.LITTLE-style) machine supplies `cpi_per_core`, one
+    entry per core, which overrides `cpi`.
+    """
+
+    cpi: int = 1
+    cpi_per_core: tuple[int, ...] | None = None
+    # O3-style overlap model (0 = pure in-order). Fraction (in 1/256ths) of a
+    # miss latency hidden by the out-of-order window; applied as
+    # charged = lat - (lat * o3_overlap_256 >> 8), still integer-exact.
+    o3_overlap_256: int = 0
+
+    def cpi_vector(self, n_cores: int) -> tuple[int, ...]:
+        if self.cpi_per_core is not None:
+            if len(self.cpi_per_core) != n_cores:
+                raise ValueError("cpi_per_core length != n_cores")
+            return tuple(self.cpi_per_core)
+        return (self.cpi,) * n_cores
+
+
+@dataclass(frozen=True)
+class NocConfig:
+    """2-D mesh NoC (SURVEY.md §2 #6: Network, XY routing, hop-by-hop)."""
+
+    mesh_x: int = 8
+    mesh_y: int = 8
+    link_lat: int = 1  # per-hop link traversal, cycles
+    router_lat: int = 1  # per-router, cycles ((hops+1) routers on a path)
+
+    @property
+    def n_tiles(self) -> int:
+        return self.mesh_x * self.mesh_y
+
+
+@dataclass(frozen=True)
+class MachineConfig:
+    """Full simulated machine (SURVEY.md §2 #11 `XmlSim` equivalent)."""
+
+    n_cores: int = 64
+    core: CoreConfig = field(default_factory=CoreConfig)
+    l1: CacheConfig = field(default_factory=lambda: CacheConfig(32 * 1024, 4, 64, 2))
+    llc: CacheConfig = field(default_factory=lambda: CacheConfig(256 * 1024, 8, 64, 10))
+    n_banks: int = 64
+    noc: NocConfig = field(default_factory=NocConfig)
+    dram_lat: int = 100
+    quantum: int = 1000  # relaxed-sync quantum, cycles (the fidelity/speed knob)
+
+    def __post_init__(self):
+        self.validate()
+
+    def validate(self) -> None:
+        if not _is_pow2(self.n_cores):
+            raise ValueError("n_cores must be a power of two")
+        if not _is_pow2(self.n_banks):
+            raise ValueError("n_banks must be a power of two")
+        self.l1.validate("l1")
+        self.llc.validate("llc")
+        if self.l1.line != self.llc.line:
+            raise ValueError("l1 and llc line sizes must match")
+        if self.quantum <= 0:
+            raise ValueError("quantum must be positive")
+
+    # Derived geometry used by both engines --------------------------------
+
+    @property
+    def line_bits(self) -> int:
+        return self.l1.line.bit_length() - 1
+
+    @property
+    def n_sharer_words(self) -> int:
+        return (self.n_cores + 31) // 32
+
+    @property
+    def n_tiles(self) -> int:
+        return self.noc.n_tiles
+
+    def core_tile(self, c: int) -> int:
+        return c % self.n_tiles
+
+    def bank_tile(self, b: int) -> int:
+        return b % self.n_tiles
+
+    # Serialization --------------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MachineConfig":
+        d = dict(d)
+        if "core" in d and isinstance(d["core"], dict):
+            c = dict(d["core"])
+            if c.get("cpi_per_core") is not None:
+                c["cpi_per_core"] = tuple(c["cpi_per_core"])
+            d["core"] = CoreConfig(**c)
+        if "l1" in d and isinstance(d["l1"], dict):
+            d["l1"] = CacheConfig(**d["l1"])
+        if "llc" in d and isinstance(d["llc"], dict):
+            d["llc"] = CacheConfig(**d["llc"])
+        if "noc" in d and isinstance(d["noc"], dict):
+            d["noc"] = NocConfig(**d["noc"])
+        return MachineConfig(**d)
+
+    @staticmethod
+    def from_json(s: str) -> "MachineConfig":
+        return MachineConfig.from_dict(json.loads(s))
+
+
+def small_test_config(n_cores: int = 4, **kw) -> MachineConfig:
+    """Tiny machine for unit tests: 4 cores, 2x2 mesh, small caches."""
+    defaults = dict(
+        n_cores=n_cores,
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=4096, ways=4, line=64, latency=10),
+        n_banks=min(4, n_cores),
+        noc=NocConfig(mesh_x=2, mesh_y=2, link_lat=1, router_lat=1),
+        dram_lat=100,
+        quantum=1000,
+    )
+    defaults.update(kw)
+    return MachineConfig(**defaults)

@@ -1,0 +1,376 @@
+"""Golden reference simulator: slow, scalar, obviously correct.
+
+Implements DESIGN.md's step semantics with plain Python/NumPy loops. This is
+the oracle the vectorized JAX engine (`primesim_tpu/sim/engine.py`) must
+match BIT-EXACTLY on per-core cycles, cache/directory state, and counters
+(SURVEY.md §4: the single highest-value test asset the reference lacks).
+
+Semantics map to the reference as: CoreManager per-core cycle accounting
+(SURVEY.md §2 #2), Cache set-assoc lookup/LRU (#3), System directory-MESI
+(#4), Network XY-hop latency (#6), Dram fixed latency (#7), and the relaxed
+quantum barrier (#10) — all serialized here in the canonical deterministic
+order DESIGN.md defines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config.machine import MachineConfig
+from ..noc.mesh import bank_tile, core_tile, hops as _hops, one_way_lat
+from ..stats.counters import zero_counters
+from ..trace.format import EV_END, EV_INS, EV_LD, EV_ST, Trace
+
+# MESI encoding shared with the JAX engine
+I, S, E, M = 0, 1, 2, 3
+
+
+class GoldenSim:
+    def __init__(self, cfg: MachineConfig, trace: Trace):
+        assert trace.n_cores == cfg.n_cores
+        self.cfg = cfg
+        self.trace = trace
+        C, B = cfg.n_cores, cfg.n_banks
+        l1s, l1w = cfg.l1.sets, cfg.l1.ways
+        ls, lw = cfg.llc.sets, cfg.llc.ways
+
+        self.cycles = np.zeros(C, dtype=np.int64)
+        self.ptr = np.zeros(C, dtype=np.int64)
+        self.cpi = np.array(cfg.core.cpi_vector(C), dtype=np.int64)
+
+        self.l1_tag = np.full((C, l1s, l1w), -1, dtype=np.int64)
+        self.l1_state = np.full((C, l1s, l1w), I, dtype=np.int64)
+        self.l1_lru = np.zeros((C, l1s, l1w), dtype=np.int64)
+
+        self.llc_tag = np.full((B, ls, lw), -1, dtype=np.int64)
+        self.llc_owner = np.full((B, ls, lw), -1, dtype=np.int64)
+        self.llc_lru = np.zeros((B, ls, lw), dtype=np.int64)
+        # sharer bit-vector words, matching the JAX engine's packed layout
+        self.sharers = np.zeros((B, ls, lw, cfg.n_sharer_words), dtype=np.uint32)
+
+        self.counters = zero_counters(C)
+        self.quantum_end = cfg.quantum
+        self.step_count = 0
+
+    # ------------------------------------------------------------ helpers
+
+    def _line(self, addr: int) -> int:
+        return addr >> self.cfg.line_bits
+
+    def _bank(self, line: int) -> int:
+        return line % self.cfg.n_banks
+
+    def _bank_set(self, line: int) -> int:
+        return (line // self.cfg.n_banks) % self.cfg.llc.sets
+
+    def _l1_set(self, line: int) -> int:
+        return line % self.cfg.l1.sets
+
+    def _victim_way(self, tags, states, lrus):
+        """Invalid-first LRU with lowest-index tie break (DESIGN.md §1)."""
+        key = [(-1 if states[w] == I else int(lrus[w])) for w in range(len(tags))]
+        return int(np.argmin(key))
+
+    def _set_sharer(self, b, s, w, core, val: bool):
+        wi, bit = core // 32, core % 32
+        if val:
+            self.sharers[b, s, w, wi] |= np.uint32(1 << bit)
+        else:
+            self.sharers[b, s, w, wi] &= np.uint32(~(1 << bit) & 0xFFFFFFFF)
+
+    def _clear_sharers(self, b, s, w):
+        self.sharers[b, s, w, :] = 0
+
+    def _noc(self, c: int, tile_a: int, tile_b: int):
+        """Charge one message tile_a->tile_b to core c's NoC counters."""
+        lat = one_way_lat(tile_a, tile_b, self.cfg)
+        self.counters["noc_msgs"][c] += 1
+        self.counters["noc_hops"][c] += _hops(tile_a, tile_b, self.cfg.noc.mesh_x)
+        return lat
+
+    # --------------------------------------------------------------- step
+
+    def done(self) -> bool:
+        t = self.trace.events
+        return all(
+            t[c, min(int(self.ptr[c]), self.trace.max_len - 1), 0] == EV_END
+            for c in range(self.cfg.n_cores)
+        )
+
+    def step(self) -> None:
+        cfg = self.cfg
+        C = cfg.n_cores
+        ev = self.trace.events
+
+        # --- quantum barrier (DESIGN.md §3): bump quantum_end if nobody active
+        cur = [ev[c, min(int(self.ptr[c]), self.trace.max_len - 1)] for c in range(C)]
+        not_done = [c for c in range(C) if cur[c][0] != EV_END]
+        if not not_done:
+            return
+        active = [c for c in not_done if self.cycles[c] < self.quantum_end]
+        if not active:
+            m = min(int(self.cycles[c]) for c in not_done)
+            self.quantum_end = (m // cfg.quantum + 1) * cfg.quantum
+            active = [c for c in not_done if self.cycles[c] < self.quantum_end]
+
+        step = self.step_count
+        self.step_count += 1
+
+        # --- phase 0/1: classify against step-start state ------------------
+        # Snapshot the arrays that phase-3 transition *reads* must see
+        # unmodified (phase A writes happen only after all reads).
+        l1_tag0 = self.l1_tag.copy()
+        l1_state0 = self.l1_state.copy()
+        l1_lru0 = self.l1_lru.copy()
+        llc_tag0 = self.llc_tag.copy()
+        llc_owner0 = self.llc_owner.copy()
+        llc_lru0 = self.llc_lru.copy()
+        sharers0 = self.sharers.copy()
+
+        requests = []  # (cycles, core, kind, line) with kind in GETS/GETM/UPG
+        GETS, GETM, UPG = 0, 1, 2
+
+        for c in active:
+            t, arg, addr = int(cur[c][0]), int(cur[c][1]), int(cur[c][2])
+            if t == EV_INS:
+                self.cycles[c] += arg * int(self.cpi[c])
+                self.counters["instructions"][c] += arg
+                self.ptr[c] += 1
+                continue
+            line = self._line(addr)
+            s = self._l1_set(line)
+            w = -1
+            for wy in range(cfg.l1.ways):
+                if l1_tag0[c, s, wy] == line and l1_state0[c, s, wy] != I:
+                    w = wy
+                    break
+            if t == EV_LD:
+                if w >= 0:  # read hit
+                    self.cycles[c] += cfg.l1.latency
+                    self.counters["l1_read_hits"][c] += 1
+                    self.counters["instructions"][c] += 1
+                    self.l1_lru[c, s, w] = step  # phase A local
+                    self.ptr[c] += 1
+                else:
+                    requests.append((int(self.cycles[c]), c, GETS, line))
+            else:  # EV_ST
+                if w >= 0 and l1_state0[c, s, w] in (E, M):  # write hit
+                    self.cycles[c] += cfg.l1.latency
+                    self.counters["l1_write_hits"][c] += 1
+                    self.counters["instructions"][c] += 1
+                    self.l1_state[c, s, w] = M  # silent E->M, phase A local
+                    self.l1_lru[c, s, w] = step
+                    self.ptr[c] += 1
+                elif w >= 0:  # held in S -> upgrade
+                    requests.append((int(self.cycles[c]), c, UPG, line))
+                else:
+                    requests.append((int(self.cycles[c]), c, GETM, line))
+
+        # --- phase 2: per-(bank,set) conflict serialization ----------------
+        by_bankset: dict[tuple[int, int], list] = {}
+        for r in requests:
+            key = (self._bank(r[3]), self._bank_set(r[3]))
+            by_bankset.setdefault(key, []).append(r)
+        winners = []
+        for key, rs in by_bankset.items():
+            rs.sort(key=lambda r: (r[0], r[1]))  # (cycles, core_id)
+            winners.append(rs[0])
+            for r in rs[1:]:
+                self.counters["retries"][r[1]] += 1
+
+        # --- phase 3: transitions on step-start state; collect phase-B ops -
+        # Phase-B op = (core, line, op) with op in {"downgrade","invalidate"}
+        phase_b: list[tuple[int, int, str]] = []
+
+        for cyc, c, kind, line in sorted(winners, key=lambda r: r[1]):
+            b = self._bank(line)
+            bs = self._bank_set(line)
+            ctile = core_tile(c, cfg)
+            btile = bank_tile(b, cfg)
+
+            lat = cfg.l1.latency
+            lat += self._noc(c, ctile, btile)  # request
+            lat += cfg.llc.latency
+
+            # LLC lookup (step-start)
+            hitw = -1
+            for wy in range(cfg.llc.ways):
+                if llc_tag0[b, bs, wy] == line:
+                    hitw = wy
+                    break
+
+            if kind == GETS:
+                self.counters["l1_read_misses"][c] += 1
+            elif kind == GETM:
+                self.counters["l1_write_misses"][c] += 1
+            else:
+                self.counters["upgrades"][c] += 1
+
+            if hitw >= 0:
+                self.counters["llc_hits"][c] += 1
+                w = hitw
+                owner = int(llc_owner0[b, bs, w])
+                shl = [
+                    t
+                    for t in self._sharers_from(sharers0, b, bs, w)
+                    if t != c
+                ]
+                if kind == GETS:
+                    if owner >= 0 and owner != c:
+                        # probe owner (charged regardless of staleness)
+                        otile = core_tile(owner, cfg)
+                        lat += self._noc(c, btile, otile)
+                        lat += self._noc(c, otile, btile)
+                        self.counters["probes"][c] += 1
+                        found = self._probe_found(l1_state0, l1_tag0, owner, line)
+                        phase_b.append((owner, line, "downgrade"))
+                        self.llc_owner[b, bs, w] = -1
+                        self._clear_sharers(b, bs, w)
+                        self._set_sharer(b, bs, w, c, True)
+                        if found:
+                            self._set_sharer(b, bs, w, owner, True)
+                        grant = S
+                    elif shl:
+                        self._set_sharer(b, bs, w, c, True)
+                        grant = S
+                    else:
+                        self.llc_owner[b, bs, w] = c
+                        self._clear_sharers(b, bs, w)
+                        grant = E
+                else:  # GETM or UPG
+                    inv_lat = 0
+                    if owner >= 0 and owner != c:
+                        otile = core_tile(owner, cfg)
+                        lat += self._noc(c, btile, otile)
+                        lat += self._noc(c, otile, btile)
+                        self.counters["probes"][c] += 1
+                        phase_b.append((owner, line, "invalidate"))
+                    for tcore in shl:
+                        ttile = core_tile(tcore, cfg)
+                        rt = one_way_lat(btile, ttile, cfg) * 2
+                        inv_lat = max(inv_lat, rt)
+                        self.counters["invalidations"][c] += 1
+                        self.counters["noc_msgs"][c] += 2
+                        self.counters["noc_hops"][c] += 2 * _hops(
+                            btile, ttile, cfg.noc.mesh_x
+                        )
+                        phase_b.append((tcore, line, "invalidate"))
+                    lat += inv_lat
+                    self.llc_owner[b, bs, w] = c
+                    self._clear_sharers(b, bs, w)
+                    grant = M
+                self.llc_lru[b, bs, w] = step
+            else:
+                # LLC miss -> DRAM + fill (UPG stale corner handled as GETM)
+                self.counters["llc_misses"][c] += 1
+                self.counters["dram_accesses"][c] += 1
+                self.counters["noc_msgs"][c] += 2  # to co-located controller
+                lat += cfg.dram_lat
+                # victim selection on step-start state
+                w = self._victim_way(
+                    llc_tag0[b, bs], self._llc_valid(llc_tag0, b, bs), llc_lru0[b, bs]
+                )
+                if llc_tag0[b, bs, w] != -1:
+                    vline = int(llc_tag0[b, bs, w])
+                    vowner = int(llc_owner0[b, bs, w])
+                    vtargets = self._sharers_from(sharers0, b, bs, w)
+                    if vowner >= 0:
+                        self.counters["llc_writebacks"][c] += 1
+                        if vowner not in vtargets:
+                            vtargets = vtargets + [vowner]
+                    for tcore in vtargets:
+                        ttile = core_tile(tcore, cfg)
+                        self.counters["invalidations"][c] += 1
+                        self.counters["noc_msgs"][c] += 2
+                        self.counters["noc_hops"][c] += 2 * _hops(
+                            btile, ttile, cfg.noc.mesh_x
+                        )
+                        phase_b.append((tcore, vline, "invalidate"))
+                self.llc_tag[b, bs, w] = line
+                self.llc_lru[b, bs, w] = step
+                if kind == GETS:
+                    self.llc_owner[b, bs, w] = c
+                    self._clear_sharers(b, bs, w)
+                    grant = E
+                else:
+                    self.llc_owner[b, bs, w] = c
+                    self._clear_sharers(b, bs, w)
+                    grant = M
+
+            lat += self._noc(c, btile, ctile)  # reply
+
+            # O3-style overlap: hide a fraction of the miss latency
+            ov = cfg.core.o3_overlap_256
+            if ov:
+                lat = lat - ((lat * ov) >> 8)
+
+            # --- phase 4.A for this winner: L1 update ----------------------
+            s = self._l1_set(line)
+            curw = -1
+            for wy in range(cfg.l1.ways):
+                if l1_tag0[c, s, wy] == line and l1_state0[c, s, wy] != I:
+                    curw = wy
+                    break
+            if kind == UPG and curw >= 0:
+                self.l1_state[c, s, curw] = grant
+                self.l1_lru[c, s, curw] = step
+            else:
+                vw = self._victim_way(
+                    l1_tag0[c, s],
+                    l1_state0[c, s],
+                    l1_lru0[c, s],
+                )
+                if l1_state0[c, s, vw] == M:
+                    self.counters["l1_writebacks"][c] += 1
+                self.l1_tag[c, s, vw] = line
+                self.l1_state[c, s, vw] = grant
+                self.l1_lru[c, s, vw] = step
+
+            self.cycles[c] += lat
+            self.counters["instructions"][c] += 1
+            self.ptr[c] += 1
+
+        # --- phase 4.B: remote ops, tag-conditional against live state -----
+        for tcore, line, op in phase_b:
+            s = self._l1_set(line)
+            for wy in range(cfg.l1.ways):
+                if self.l1_tag[tcore, s, wy] == line and self.l1_state[tcore, s, wy] != I:
+                    if op == "downgrade":
+                        if self.l1_state[tcore, s, wy] in (E, M):
+                            self.l1_state[tcore, s, wy] = S
+                    else:
+                        self.l1_state[tcore, s, wy] = I
+                    break
+
+    # ----------------------------------------------------- static helpers
+
+    def _llc_valid(self, llc_tag0, b, bs):
+        """Map tags to pseudo-states for victim selection (valid=1, I=0)."""
+        return [I if llc_tag0[b, bs, w] == -1 else S for w in range(self.cfg.llc.ways)]
+
+    @staticmethod
+    def _probe_found(l1_state0, l1_tag0, owner, line):
+        sets = l1_tag0.shape[1]
+        s = line % sets
+        for wy in range(l1_tag0.shape[2]):
+            if l1_tag0[owner, s, wy] == line and l1_state0[owner, s, wy] != I:
+                return True
+        return False
+
+    def _sharers_from(self, sharers0, b, s, w) -> list[int]:
+        out = []
+        for wi in range(sharers0.shape[3]):
+            word = int(sharers0[b, s, w, wi])
+            for bit in range(32):
+                if word & (1 << bit):
+                    out.append(wi * 32 + bit)
+        return out
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, max_steps: int = 10_000_000) -> None:
+        for _ in range(max_steps):
+            if self.done():
+                return
+            self.step()
+        raise RuntimeError("golden: max_steps exceeded (deadlock?)")

@@ -1,0 +1,37 @@
+"""2-D mesh NoC geometry and analytic latency (DESIGN.md §1).
+
+TPU-native replacement for the reference's hop-by-hop `Network` mesh router
+(SURVEY.md §2 #6). v1 is the analytic uncontended model shared verbatim by
+the golden simulator and the JAX engine (these helpers are written so they
+work on NumPy arrays AND traced jnp arrays alike). The congestion-aware
+Pallas router (per-link occupancy, ICI neighbor exchange under shard_map) is
+the planned v2 behind `NocConfig` gating.
+"""
+
+from __future__ import annotations
+
+from ..config.machine import MachineConfig
+
+
+def tile_xy(tile, mesh_x: int):
+    return tile % mesh_x, tile // mesh_x
+
+
+def hops(tile_a, tile_b, mesh_x: int):
+    ax, ay = tile_xy(tile_a, mesh_x)
+    bx, by = tile_xy(tile_b, mesh_x)
+    return abs(ax - bx) + abs(ay - by)
+
+
+def one_way_lat(tile_a, tile_b, cfg: MachineConfig):
+    """One-way message latency: hops*link + (hops+1)*router."""
+    h = hops(tile_a, tile_b, cfg.noc.mesh_x)
+    return h * cfg.noc.link_lat + (h + 1) * cfg.noc.router_lat
+
+
+def core_tile(core, cfg: MachineConfig):
+    return core % cfg.n_tiles
+
+
+def bank_tile(bank, cfg: MachineConfig):
+    return bank % cfg.n_tiles

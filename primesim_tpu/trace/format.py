@@ -1,0 +1,125 @@
+"""Trace event format and binary trace files.
+
+TPU-native replacement for the reference's Pin-frontend event stream
+(SURVEY.md §2 #1, §3.2/3.3: per-BBL instruction-count batching + per-access
+`execMem(addr, size, R/W)` analysis calls). Events are fixed 3x int32 records
+so host->device ingest is a single contiguous copy and the C++ frontend
+(`primesim_tpu/frontend/`) can write the same format with one fwrite.
+
+Binary file layout (little-endian):
+    magic   uint32  0x50545055  ("PTPU")
+    version uint32  1
+    n_cores uint32
+    max_len uint32  (padded per-core event count)
+    lengths uint32[n_cores]  (true event count per core, <= max_len)
+    events  int32[n_cores, max_len, 3]   (type, arg, addr)
+
+Cores with fewer than max_len events are padded with END events.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MAGIC = 0x50545055
+VERSION = 1
+
+# Event types (DESIGN.md §2)
+EV_INS = 0  # batch of non-memory instructions; arg = count
+EV_LD = 1  # load;  addr = byte address (31-bit in v1), arg = size
+EV_ST = 2  # store; addr = byte address (31-bit in v1), arg = size
+EV_END = 3  # core finished
+
+N_FIELDS = 3  # (type, arg, addr)
+
+
+class Trace:
+    """Per-core event arrays: events[n_cores, max_len, 3] int32."""
+
+    def __init__(self, events: np.ndarray, lengths: np.ndarray):
+        events = np.asarray(events, dtype=np.int32)
+        lengths = np.asarray(lengths, dtype=np.int32)
+        assert events.ndim == 3 and events.shape[2] == N_FIELDS
+        assert lengths.shape == (events.shape[0],)
+        t = events[:, :, 0]
+        if t.size:
+            if not ((t >= EV_INS) & (t <= EV_END)).all():
+                raise ValueError("trace contains invalid event types")
+            mem = (t == EV_LD) | (t == EV_ST)
+            if (events[:, :, 2][mem] < 0).any():
+                raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
+            if (lengths > events.shape[1]).any() or (lengths < 1).any():
+                raise ValueError("per-core lengths out of range")
+            # every core's row must terminate: the event at lengths-1 is END
+            # and padding beyond it is END (engines clamp ptr to max_len-1)
+            last = events[np.arange(events.shape[0]), lengths - 1, 0]
+            if (last != EV_END).any() or (events[:, -1, 0] != EV_END).any():
+                raise ValueError("every core's event row must terminate with END")
+        self.events = events
+        self.lengths = lengths
+
+    @property
+    def n_cores(self) -> int:
+        return self.events.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.events.shape[1]
+
+    def total_instructions(self) -> int:
+        """Total simulated instructions (INS batch counts + 1 per mem op)."""
+        t = self.events[:, :, 0]
+        ins = np.where(t == EV_INS, self.events[:, :, 1], 0).astype(np.int64).sum()
+        mem = int(((t == EV_LD) | (t == EV_ST)).sum())
+        return int(ins) + mem
+
+    # ---------------------------------------------------------------- I/O
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            hdr = np.array([MAGIC, VERSION, self.n_cores, self.max_len], dtype="<u4")
+            hdr.tofile(f)
+            self.lengths.astype("<u4").tofile(f)
+            self.events.astype("<i4").tofile(f)
+
+    @staticmethod
+    def load(path: str) -> "Trace":
+        with open(path, "rb") as f:
+            hdr = np.fromfile(f, dtype="<u4", count=4)
+            if hdr.shape[0] != 4 or hdr[0] != MAGIC:
+                raise ValueError(f"{path}: not a primesim_tpu trace file")
+            if hdr[1] != VERSION:
+                raise ValueError(f"{path}: unsupported trace version {hdr[1]}")
+            n_cores, max_len = int(hdr[2]), int(hdr[3])
+            lengths = np.fromfile(f, dtype="<u4", count=n_cores).astype(np.int32)
+            events = np.fromfile(f, dtype="<i4", count=n_cores * max_len * N_FIELDS)
+            if events.size != n_cores * max_len * N_FIELDS:
+                raise ValueError(f"{path}: truncated trace file")
+            events = events.reshape(n_cores, max_len, N_FIELDS).astype(np.int32)
+        return Trace(events, lengths)
+
+
+def from_event_lists(per_core: list[list[tuple[int, int, int]]]) -> Trace:
+    """Build a padded Trace from python per-core event lists.
+
+    Each event is (type, arg, addr). An END event is appended to every core.
+    """
+    n_cores = len(per_core)
+    lengths = np.array([len(evs) + 1 for evs in per_core], dtype=np.int32)
+    max_len = int(lengths.max()) if n_cores else 1
+    events = np.empty((n_cores, max_len, N_FIELDS), dtype=np.int32)
+    events[:, :, 0] = EV_END
+    events[:, :, 1] = 0
+    events[:, :, 2] = 0
+    for c, evs in enumerate(per_core):
+        if evs:
+            arr = np.asarray(evs, dtype=np.int64)
+            # addresses may be given as uint32-range python ints; view as int32
+            e = np.empty((len(evs), N_FIELDS), dtype=np.int32)
+            e[:, 0] = arr[:, 0].astype(np.int32)
+            e[:, 1] = arr[:, 1].astype(np.int32)
+            if (arr[:, 2] < 0).any() or (arr[:, 2] >= 2**31).any():
+                raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
+            e[:, 2] = arr[:, 2].astype(np.int32)
+            events[c, : len(evs)] = e
+    return Trace(events, lengths)

@@ -1,0 +1,13 @@
+"""Test environment: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+This is the single-host stand-in for multi-chip TPU (SURVEY.md §4d): all
+sharding/shard_map logic is exercised on 8 virtual CPU devices; the driver
+separately dry-run-compiles the multi-chip path via __graft_entry__.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
