@@ -69,6 +69,14 @@ class CoreConfig:
             return tuple(self.cpi_per_core)
         return (self.cpi,) * n_cores
 
+    def validate(self) -> None:
+        if self.cpi < 1 or (
+            self.cpi_per_core is not None and any(c < 1 for c in self.cpi_per_core)
+        ):
+            raise ValueError("core cpi values must be >= 1")
+        if not (0 <= self.o3_overlap_256 < 256):
+            raise ValueError("o3_overlap_256 must be in [0, 256)")
+
 
 @dataclass(frozen=True)
 class NocConfig:
@@ -105,12 +113,19 @@ class MachineConfig:
             raise ValueError("n_cores must be a power of two")
         if not _is_pow2(self.n_banks):
             raise ValueError("n_banks must be a power of two")
+        self.core.validate()
         self.l1.validate("l1")
         self.llc.validate("llc")
         if self.l1.line != self.llc.line:
             raise ValueError("l1 and llc line sizes must match")
         if self.quantum <= 0:
             raise ValueError("quantum must be positive")
+        if self.dram_lat < 0:
+            raise ValueError("dram_lat must be >= 0")
+        if self.noc.link_lat < 0 or self.noc.router_lat < 0:
+            raise ValueError("NoC latencies must be >= 0")
+        if self.noc.mesh_x < 1 or self.noc.mesh_y < 1:
+            raise ValueError("mesh dims must be >= 1")
 
     # Derived geometry used by both engines --------------------------------
 
@@ -125,12 +140,6 @@ class MachineConfig:
     @property
     def n_tiles(self) -> int:
         return self.noc.n_tiles
-
-    def core_tile(self, c: int) -> int:
-        return c % self.n_tiles
-
-    def bank_tile(self, b: int) -> int:
-        return b % self.n_tiles
 
     # Serialization --------------------------------------------------------
 

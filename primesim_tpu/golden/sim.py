@@ -117,15 +117,14 @@ class GoldenSim:
         self.step_count += 1
 
         # --- phase 0/1: classify against step-start state ------------------
-        # Snapshot the arrays that phase-3 transition *reads* must see
-        # unmodified (phase A writes happen only after all reads).
+        # Only the L1 tag/state arrays need step-start snapshots: phase-3
+        # reads of OTHER cores' L1 rows (owner probes) must not see this
+        # step's phase-A writes. Every other read in the step touches rows
+        # that nothing else writes within the step (winners own their
+        # (bank,set) exclusively; cores own their L1 row), so live arrays
+        # are equivalent and the expensive LLC copies are skipped.
         l1_tag0 = self.l1_tag.copy()
         l1_state0 = self.l1_state.copy()
-        l1_lru0 = self.l1_lru.copy()
-        llc_tag0 = self.llc_tag.copy()
-        llc_owner0 = self.llc_owner.copy()
-        llc_lru0 = self.llc_lru.copy()
-        sharers0 = self.sharers.copy()
 
         requests = []  # (cycles, core, kind, line) with kind in GETS/GETM/UPG
         GETS, GETM, UPG = 0, 1, 2
@@ -195,7 +194,7 @@ class GoldenSim:
             # LLC lookup (step-start)
             hitw = -1
             for wy in range(cfg.llc.ways):
-                if llc_tag0[b, bs, wy] == line:
+                if self.llc_tag[b, bs, wy] == line:
                     hitw = wy
                     break
 
@@ -209,10 +208,10 @@ class GoldenSim:
             if hitw >= 0:
                 self.counters["llc_hits"][c] += 1
                 w = hitw
-                owner = int(llc_owner0[b, bs, w])
+                owner = int(self.llc_owner[b, bs, w])
                 shl = [
                     t
-                    for t in self._sharers_from(sharers0, b, bs, w)
+                    for t in self._sharers_from(self.sharers, b, bs, w)
                     if t != c
                 ]
                 if kind == GETS:
@@ -268,12 +267,14 @@ class GoldenSim:
                 lat += cfg.dram_lat
                 # victim selection on step-start state
                 w = self._victim_way(
-                    llc_tag0[b, bs], self._llc_valid(llc_tag0, b, bs), llc_lru0[b, bs]
+                    self.llc_tag[b, bs],
+                    self._llc_valid(self.llc_tag, b, bs),
+                    self.llc_lru[b, bs],
                 )
-                if llc_tag0[b, bs, w] != -1:
-                    vline = int(llc_tag0[b, bs, w])
-                    vowner = int(llc_owner0[b, bs, w])
-                    vtargets = self._sharers_from(sharers0, b, bs, w)
+                if self.llc_tag[b, bs, w] != -1:
+                    vline = int(self.llc_tag[b, bs, w])
+                    vowner = int(self.llc_owner[b, bs, w])
+                    vtargets = self._sharers_from(self.sharers, b, bs, w)
                     if vowner >= 0:
                         self.counters["llc_writebacks"][c] += 1
                         if vowner not in vtargets:
@@ -318,7 +319,7 @@ class GoldenSim:
                 vw = self._victim_way(
                     l1_tag0[c, s],
                     l1_state0[c, s],
-                    l1_lru0[c, s],
+                    self.l1_lru[c, s],
                 )
                 if l1_state0[c, s, vw] == M:
                     self.counters["l1_writebacks"][c] += 1

@@ -1,0 +1,419 @@
+"""Vectorized JAX simulation engine — the TPU re-host of PriME's backend.
+
+One `step()` advances every target core by at most one trace event,
+implementing DESIGN.md's canonical per-step semantics branchlessly:
+
+- CoreManager's per-core cycle tick (SURVEY.md §2 #2) is a masked lane
+  update over the core axis (the `jax.vmap`-shaped dimension, fused by XLA).
+- The private-cache lookup (#3), directory-MESI transition (#4), mesh-NoC
+  latency (#6), and DRAM charge (#7) are `where`-chains + gathers/scatters
+  over `[C]`-shaped lanes — no data-dependent Python control flow.
+- The uncore request serializer (#5: `System::sim()` worker loop) becomes a
+  scatter-min arbitration: one winner per LLC (bank,set) per step.
+- The relaxed quantum barrier (#10) is the active-mask + quantum_end bump;
+  the outer `lax.scan` step IS the quantum-bounded global clock [DRIVER].
+
+The engine must match `primesim_tpu.golden.sim.GoldenSim` BIT-EXACTLY —
+tests/test_parity.py enforces this on every workload generator.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.machine import MachineConfig
+from ..stats.counters import COUNTER_NAMES, zero_counters
+from ..trace.format import EV_END, EV_INS, EV_LD, EV_ST, Trace
+from .state import E, I, M, MachineState, S, init_state
+
+INT32_MAX = np.int32(2**31 - 1)
+
+_CIDX = {k: i for i, k in enumerate(COUNTER_NAMES)}
+
+
+def _one_way(tile_a, tile_b, cfg: MachineConfig):
+    """Vectorized mesh latency + hop count (noc/mesh.py semantics)."""
+    mx = cfg.noc.mesh_x
+    ax, ay = tile_a % mx, tile_a // mx
+    bx, by = tile_b % mx, tile_b // mx
+    h = jnp.abs(ax - bx) + jnp.abs(ay - by)
+    return h * cfg.noc.link_lat + (h + 1) * cfg.noc.router_lat, h
+
+
+def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineState:
+    C = cfg.n_cores
+    B = cfg.n_banks
+    S1, W1 = cfg.l1.sets, cfg.l1.ways
+    S2, W2 = cfg.llc.sets, cfg.llc.ways
+    NW = cfg.n_sharer_words
+    Q = cfg.quantum
+    T = events.shape[1]
+    n_tiles = cfg.n_tiles
+    arange_c = jnp.arange(C, dtype=jnp.int32)
+
+    cnt = st.counters
+
+    def cadd(cnt, name, amount):
+        return cnt.at[_CIDX[name]].add(amount.astype(jnp.int32))
+
+    # ---- phase 0: gather events, quantum barrier -------------------------
+    p = jnp.minimum(st.ptr, T - 1)
+    ev = events[arange_c, p]  # [C, 3]
+    et, earg, eaddr = ev[:, 0], ev[:, 1], ev[:, 2]
+    not_done = et != EV_END
+    any_not_done = jnp.any(not_done)
+    any_active = jnp.any(not_done & (st.cycles < st.quantum_end))
+    min_nd = jnp.min(jnp.where(not_done, st.cycles, INT32_MAX))
+    bumped = (min_nd // Q + 1) * Q
+    quantum_end = jnp.where(any_not_done & ~any_active, bumped, st.quantum_end)
+    active = not_done & (st.cycles < quantum_end)
+
+    step_no = st.step
+
+    is_ins = active & (et == EV_INS)
+    is_st_ev = et == EV_ST
+    is_mem = active & ((et == EV_LD) | is_st_ev)
+
+    # ---- phase 1: L1 lookup + classification (step-start state) ----------
+    line = eaddr >> cfg.line_bits  # [C] int32 (addresses < 2^31)
+    l1s = line & (S1 - 1)
+    tag_rows = st.l1_tag[arange_c, l1s]  # [C, W1]
+    state_rows = st.l1_state[arange_c, l1s]  # [C, W1]
+    l1_match = (tag_rows == line[:, None]) & (state_rows != I)
+    hit_any = jnp.any(l1_match, axis=1)
+    hit_way = jnp.argmax(l1_match, axis=1).astype(jnp.int32)
+    hit_state = state_rows[arange_c, hit_way]
+
+    read_hit = is_mem & ~is_st_ev & hit_any
+    write_hit = is_mem & is_st_ev & hit_any & (hit_state >= E)
+    upg = is_mem & is_st_ev & hit_any & (hit_state == S)
+    gets = is_mem & ~is_st_ev & ~hit_any
+    getm = is_mem & is_st_ev & ~hit_any
+    req = gets | getm | upg
+
+    # ---- phase 2: per-(bank,set) winner arbitration ----------------------
+    bank = line & (B - 1)
+    bset = (line >> (B.bit_length() - 1)) & (S2 - 1)
+    slot = bank * S2 + bset  # [C], exact (bank,set) id
+    rel = st.cycles - (quantum_end - Q)  # in [0, Q) for active requesters
+    key = rel * C + arange_c  # orders by (cycles, core_id); < Q*C < 2^31
+    table = jnp.full(B * S2, INT32_MAX, jnp.int32)
+    table = table.at[jnp.where(req, slot, B * S2)].min(key, mode="drop")
+    winner = req & (table[slot] == key)
+    retry = req & ~winner
+    cnt = cadd(cnt, "retries", retry)
+
+    # ---- phase 3: directory transition on step-start state ---------------
+    ctile = arange_c % n_tiles
+    btile = bank % n_tiles
+    req_lat, req_hops = _one_way(ctile, btile, cfg)
+    rep_lat, rep_hops = _one_way(btile, ctile, cfg)
+
+    llc_tag_rows = st.llc_tag[bank, bset]  # [C, W2]
+    llc_match = llc_tag_rows == line[:, None]
+    llc_hit = jnp.any(llc_match, axis=1) & winner
+    llc_hway = jnp.argmax(llc_match, axis=1).astype(jnp.int32)
+    llc_miss = winner & ~jnp.any(llc_match, axis=1)
+
+    owner = st.llc_owner[bank, bset, llc_hway]  # [C]
+    shw = st.sharers[bank, bset, llc_hway]  # [C, NW]
+
+    # unpack sharer bits into a [winner, target] matrix
+    word_idx = arange_c // 32  # [C] target -> word
+    bit_idx = (arange_c % 32).astype(jnp.uint32)
+    sh_bits = ((shw[:, word_idx] >> bit_idx[None, :]) & jnp.uint32(1)).astype(jnp.bool_)
+    sh_bits = sh_bits & (arange_c[None, :] != arange_c[:, None])  # exclude self
+
+    # per-pair round-trip latency/hops from home bank to target core
+    ttile = arange_c % n_tiles  # target tiles
+    pair_lat, pair_hops = _one_way(btile[:, None], ttile[None, :], cfg)
+
+    has_owner = llc_hit & (owner >= 0) & (owner != arange_c)
+    oclamp = jnp.maximum(owner, 0)
+    otile = oclamp % n_tiles
+    po_lat, po_hops = _one_way(btile, otile, cfg)  # bank -> owner (symmetric back)
+
+    # does the owner actually still hold the line? (lazy directory, GETS)
+    own_tag_rows = st.l1_tag[oclamp, l1s]  # [C, W1]
+    own_state_rows = st.l1_state[oclamp, l1s]
+    own_found = jnp.any((own_tag_rows == line[:, None]) & (own_state_rows != I), axis=1)
+
+    is_write_req = getm | upg
+    gets_w = gets & winner
+    write_w = is_write_req & winner
+
+    # --- GETS grant decision
+    other_sharers = jnp.any(sh_bits, axis=1)
+    gets_probe = gets_w & llc_hit & has_owner
+    gets_shared = gets_w & llc_hit & ~has_owner & other_sharers
+    gets_excl_hit = gets_w & llc_hit & ~has_owner & ~other_sharers
+
+    # --- write path: invalidations to recorded sharers (LLC hit only)
+    inv_pairs = sh_bits & (write_w & llc_hit)[:, None]  # [C, C]
+    inv_lat = jnp.max(jnp.where(inv_pairs, 2 * pair_lat, 0), axis=1)
+    inv_count = jnp.sum(inv_pairs, axis=1).astype(jnp.int32)
+    inv_hops = jnp.sum(jnp.where(inv_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
+    write_probe = write_w & llc_hit & has_owner
+
+    # --- LLC miss: victim + back-invalidation
+    llc_state_valid = llc_tag_rows != -1
+    llc_lru_rows = st.llc_lru[bank, bset]
+    vkey = jnp.where(llc_state_valid, llc_lru_rows, -1)
+    llc_vway = jnp.argmin(vkey, axis=1).astype(jnp.int32)
+    vic_tag = llc_tag_rows[arange_c, llc_vway]
+    vic_owner = st.llc_owner[bank, bset, llc_vway]
+    vic_shw = st.sharers[bank, bset, llc_vway]
+    vic_valid = llc_miss & (vic_tag != -1)
+    vic_sh_bits = ((vic_shw[:, word_idx] >> bit_idx[None, :]) & jnp.uint32(1)).astype(
+        jnp.bool_
+    )
+    # back-inv targets: recorded sharers plus the owner (golden adds owner
+    # to vtargets when not already recorded as a sharer)
+    vic_owner_bit = (arange_c[None, :] == vic_owner[:, None]) & (vic_owner >= 0)[:, None]
+    back_pairs = (vic_sh_bits | vic_owner_bit) & vic_valid[:, None]
+    back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)
+    back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
+
+    # --- latency composition (golden order)
+    probe_any = gets_probe | write_probe
+    lat = cfg.l1.latency + req_lat + cfg.llc.latency
+    lat = lat + jnp.where(probe_any, 2 * po_lat, 0)
+    lat = lat + jnp.where(write_w & llc_hit, inv_lat, 0)
+    lat = lat + jnp.where(llc_miss, cfg.dram_lat, 0)
+    lat = lat + rep_lat
+    ov = cfg.core.o3_overlap_256
+    if ov:
+        lat = lat - ((lat * ov) >> 8)
+
+    # --- granted L1 state
+    grant = jnp.where(
+        write_w,
+        M,
+        jnp.where(gets_probe | gets_shared, S, E),  # GETS: E on excl/miss
+    )
+
+    # ---- counters for winners -------------------------------------------
+    cnt = cadd(cnt, "l1_read_misses", gets_w)
+    cnt = cadd(cnt, "l1_write_misses", getm & winner)
+    cnt = cadd(cnt, "upgrades", upg & winner)
+    cnt = cadd(cnt, "llc_hits", llc_hit)
+    cnt = cadd(cnt, "llc_misses", llc_miss)
+    cnt = cadd(cnt, "dram_accesses", llc_miss)
+    cnt = cadd(cnt, "llc_writebacks", llc_miss & vic_valid & (vic_owner >= 0))
+    cnt = cadd(cnt, "probes", probe_any)
+    cnt = cadd(cnt, "invalidations", jnp.where(write_w & llc_hit, inv_count, 0) + back_count)
+    noc_msgs = (
+        jnp.where(winner, 2, 0)  # request + reply
+        + jnp.where(probe_any, 2, 0)
+        + jnp.where(write_w & llc_hit, 2 * inv_count, 0)
+        + jnp.where(llc_miss, 2, 0)  # DRAM (co-located controller)
+        + 2 * back_count
+    )
+    noc_hops = (
+        jnp.where(winner, req_hops + rep_hops, 0)
+        + jnp.where(probe_any, 2 * po_hops, 0)
+        + jnp.where(write_w & llc_hit, inv_hops, 0)
+        + back_hops
+    )
+    cnt = cadd(cnt, "noc_msgs", noc_msgs)
+    cnt = cadd(cnt, "noc_hops", noc_hops)
+
+    # ---- phase 4.A: local updates ----------------------------------------
+    # retire + clock advance
+    hit = read_hit | write_hit
+    cnt = cadd(cnt, "l1_read_hits", read_hit)
+    cnt = cadd(cnt, "l1_write_hits", write_hit)
+    retired = is_ins | hit | winner
+    cycles = st.cycles + jnp.where(
+        is_ins,
+        earg * jnp.asarray(cfg.core.cpi_vector(C), jnp.int32),
+        jnp.where(hit, cfg.l1.latency, jnp.where(winner, lat, 0)),
+    )
+    ptr = st.ptr + retired.astype(jnp.int32)
+    cnt = cadd(
+        cnt,
+        "instructions",
+        jnp.where(is_ins, earg, 0) + (hit | winner).astype(jnp.int32),
+    )
+
+    # L1 hit refresh (+ silent E->M)
+    hrow = jnp.where(hit, arange_c, C)  # OOB-drop for non-hit lanes
+    l1_lru = st.l1_lru.at[hrow, l1s, hit_way].set(step_no, mode="drop")
+    l1_state = st.l1_state.at[
+        jnp.where(write_hit, arange_c, C), l1s, hit_way
+    ].set(M, mode="drop")
+    l1_tag = st.l1_tag
+
+    # winner L1 update: UPG-in-place vs fill
+    upg_in_place = (upg & winner) & hit_any
+    fill = winner & ~upg_in_place
+    l1_vkey = jnp.where(state_rows == I, -1, st.l1_lru[arange_c, l1s])
+    l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
+    cnt = cadd(cnt, "l1_writebacks", fill & (state_rows[arange_c, l1_vway] == M))
+    upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
+    wrow = jnp.where(winner, arange_c, C)
+    l1_tag = l1_tag.at[wrow, l1s, upd_way].set(line, mode="drop")
+    l1_state = l1_state.at[wrow, l1s, upd_way].set(grant, mode="drop")
+    l1_lru = l1_lru.at[wrow, l1s, upd_way].set(step_no, mode="drop")
+
+    # LLC entry update (one winner per (bank,set) -> collision-free)
+    llc_uway = jnp.where(llc_hit, llc_hway, llc_vway)
+    wbank = jnp.where(winner, bank, B)
+    llc_tag_n = st.llc_tag.at[wbank, bset, llc_uway].set(line, mode="drop")
+    llc_lru_n = st.llc_lru.at[wbank, bset, llc_uway].set(step_no, mode="drop")
+    new_owner = jnp.where(write_w | gets_excl_hit | llc_miss, arange_c, -1)
+    llc_owner_n = st.llc_owner.at[wbank, bset, llc_uway].set(new_owner, mode="drop")
+
+    # new sharer words [C, NW]
+    self_word = (
+        (jnp.arange(NW)[None, :] == word_idx[:, None]).astype(jnp.uint32)
+        << bit_idx[:, None]
+    )  # bit(c) as packed words
+    owner_word = jnp.where(
+        (jnp.arange(NW)[None, :] == (oclamp // 32)[:, None]) & own_found[:, None],
+        jnp.uint32(1) << (oclamp % 32).astype(jnp.uint32)[:, None],
+        jnp.uint32(0),
+    )
+    new_shw = jnp.where(
+        gets_probe[:, None],
+        self_word | owner_word,
+        jnp.where(
+            gets_shared[:, None],
+            shw | self_word,
+            jnp.zeros_like(shw),  # M grants, E grants, misses: cleared
+        ),
+    )
+    sharers_n = st.sharers.at[wbank, bset, llc_uway].set(new_shw, mode="drop")
+
+    # ---- phase 4.B: remote ops, tag-conditional against post-A state -----
+    # (1) request-line ops: owner probe (downgrade/invalidate) + sharer invs
+    dn_pairs = (arange_c[None, :] == oclamp[:, None]) & (gets_probe)[:, None]
+    oi_pairs = (arange_c[None, :] == oclamp[:, None]) & (write_probe)[:, None]
+    reqline_pairs = dn_pairs | oi_pairs | inv_pairs
+    downgrade_pairs = dn_pairs & ~oi_pairs & ~inv_pairs
+
+    def apply_remote(l1_tag, l1_state, pairs, dgrade, pline):
+        # pairs: [C(winner i), C(target j)]; pline: [C] line per winner
+        s_i = pline & (S1 - 1)  # [C]
+        tgt_tags = l1_tag[arange_c[None, :], s_i[:, None]]  # [C, C, W1]
+        tgt_states = l1_state[arange_c[None, :], s_i[:, None]]
+        m = (tgt_tags == pline[:, None, None]) & (tgt_states != I)
+        has = jnp.any(m, axis=2) & pairs
+        way = jnp.argmax(m, axis=2).astype(jnp.int32)
+        j = jnp.broadcast_to(arange_c[None, :], (C, C))
+        sfull = jnp.broadcast_to(s_i[:, None], (C, C))
+        cur = tgt_states[jnp.arange(C)[:, None], jnp.arange(C)[None, :], way]
+        newv = jnp.where(
+            dgrade, jnp.where(cur >= E, S, cur), I
+        )  # downgrade E/M->S else invalidate
+        jf = jnp.where(has, j, C).reshape(-1)
+        return l1_state.at[jf, sfull.reshape(-1), way.reshape(-1)].set(
+            newv.reshape(-1), mode="drop"
+        )
+
+    l1_state = apply_remote(l1_tag, l1_state, reqline_pairs, downgrade_pairs, line)
+    # (2) back-invalidations for the LLC victim line
+    l1_state = apply_remote(
+        l1_tag, l1_state, back_pairs, jnp.zeros_like(back_pairs), vic_tag
+    )
+
+    return MachineState(
+        cycles=cycles,
+        ptr=ptr,
+        l1_tag=l1_tag,
+        l1_state=l1_state,
+        l1_lru=l1_lru,
+        llc_tag=llc_tag_n,
+        llc_owner=llc_owner_n,
+        llc_lru=llc_lru_n,
+        sharers=sharers_n,
+        quantum_end=quantum_end,
+        step=step_no + 1,
+        counters=cnt,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def run_chunk(cfg: MachineConfig, n_steps: int, events, st: MachineState):
+    """lax.scan over `n_steps` steps — the jitted hot loop."""
+
+    def body(carry, _):
+        return step(cfg, events, carry), None
+
+    st, _ = jax.lax.scan(body, st, None, length=n_steps)
+    return st
+
+
+class Engine:
+    """Chunked host runner (SURVEY.md §2 #8 UncoreManager equivalent).
+
+    Runs jitted scan chunks, and between chunks: checks termination, drains
+    int32 device counters into int64 host accumulators, and rebases the
+    epoch-relative clocks (by a multiple of the quantum, preserving barrier
+    arithmetic) so int32 never overflows.
+    """
+
+    def __init__(self, cfg: MachineConfig, trace: Trace, chunk_steps: int = 256):
+        assert trace.n_cores == cfg.n_cores
+        self.cfg = cfg
+        self.trace = trace
+        self.events = jnp.asarray(trace.events)
+        self.state = init_state(cfg)
+        self.chunk_steps = chunk_steps
+        self.cycle_base = np.int64(0)
+        self.host_counters = zero_counters(cfg.n_cores)
+        self.steps_run = 0
+
+    def _drain(self) -> None:
+        cnt = np.asarray(self.state.counters)
+        for i, k in enumerate(COUNTER_NAMES):
+            self.host_counters[k] += cnt[i].astype(np.int64)
+        self.state = self.state._replace(
+            counters=jnp.zeros_like(self.state.counters)
+        )
+
+    def _rebase(self) -> None:
+        cyc = np.asarray(self.state.cycles)
+        et = np.asarray(self.events[np.arange(self.cfg.n_cores),
+                                    np.minimum(np.asarray(self.state.ptr),
+                                               self.trace.max_len - 1), 0])
+        nd = et != EV_END
+        if not nd.any():
+            return
+        delta = (int(cyc[nd].min()) // self.cfg.quantum) * self.cfg.quantum
+        if delta <= 0:
+            return
+        self.cycle_base += delta
+        self.state = self.state._replace(
+            cycles=self.state.cycles - np.int32(delta),
+            quantum_end=self.state.quantum_end - np.int32(delta),
+        )
+
+    def done(self) -> bool:
+        p = np.minimum(np.asarray(self.state.ptr), self.trace.max_len - 1)
+        et = self.trace.events[np.arange(self.cfg.n_cores), p, 0]
+        return bool((et == EV_END).all())
+
+    def run(self, max_steps: int = 10_000_000) -> None:
+        while self.steps_run < max_steps and not self.done():
+            self.state = run_chunk(self.cfg, self.chunk_steps, self.events, self.state)
+            self.steps_run += self.chunk_steps
+            self._drain()
+            self._rebase()
+        if not self.done():
+            raise RuntimeError("engine: max_steps exceeded (deadlock?)")
+
+    # ---- results ---------------------------------------------------------
+
+    @property
+    def cycles(self) -> np.ndarray:
+        return np.asarray(self.state.cycles).astype(np.int64) + self.cycle_base
+
+    @property
+    def counters(self) -> dict[str, np.ndarray]:
+        self._drain()
+        return self.host_counters

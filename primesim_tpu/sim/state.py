@@ -1,0 +1,72 @@
+"""Machine state pytree — the lax.scan carry.
+
+The entire simulated machine (SURVEY.md §5.4: "the scan carry IS the
+checkpoint") lives in this one NamedTuple of device arrays: core clocks and
+trace pointers (CoreManager state, SURVEY.md §2 #2), L1 arrays (#3), LLC +
+directory arrays (#3/#4), the quantum clock (#10), and stat counters (#12).
+Everything is int32/uint32 so state stays compact and TPU-friendly; the host
+runner rebases clocks and drains counters into int64 between chunks.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.machine import MachineConfig
+from ..stats.counters import COUNTER_NAMES
+
+# MESI encoding (shared with primesim_tpu.golden.sim)
+I, S, E, M = 0, 1, 2, 3
+
+
+class MachineState(NamedTuple):
+    # core (CoreManager)
+    cycles: jnp.ndarray  # [C] int32 — per-core clock (epoch-relative)
+    ptr: jnp.ndarray  # [C] int32 — next trace event index
+    # L1 (private caches)
+    l1_tag: jnp.ndarray  # [C, S1, W1] int32, -1 = invalid
+    l1_state: jnp.ndarray  # [C, S1, W1] int32 MESI
+    l1_lru: jnp.ndarray  # [C, S1, W1] int32 step-stamp
+    # LLC banks + directory
+    llc_tag: jnp.ndarray  # [B, S2, W2] int32, -1 = invalid
+    llc_owner: jnp.ndarray  # [B, S2, W2] int32 core id or -1
+    llc_lru: jnp.ndarray  # [B, S2, W2] int32 step-stamp
+    sharers: jnp.ndarray  # [B, S2, W2, NW] uint32 packed sharer bits
+    # global clocks
+    quantum_end: jnp.ndarray  # [] int32
+    step: jnp.ndarray  # [] int32
+    # stat counters, one row per COUNTER_NAMES entry
+    counters: jnp.ndarray  # [n_counters, C] int32
+
+
+def init_state(cfg: MachineConfig) -> MachineState:
+    C, B = cfg.n_cores, cfg.n_banks
+    s1, w1 = cfg.l1.sets, cfg.l1.ways
+    s2, w2 = cfg.llc.sets, cfg.llc.ways
+    nw = cfg.n_sharer_words
+    if cfg.quantum * cfg.n_cores >= 2**31:
+        raise ValueError(
+            "quantum * n_cores must be < 2^31 (conflict-key packing); "
+            f"got {cfg.quantum} * {cfg.n_cores}"
+        )
+    return MachineState(
+        cycles=jnp.zeros(C, jnp.int32),
+        ptr=jnp.zeros(C, jnp.int32),
+        l1_tag=jnp.full((C, s1, w1), -1, jnp.int32),
+        l1_state=jnp.full((C, s1, w1), I, jnp.int32),
+        l1_lru=jnp.zeros((C, s1, w1), jnp.int32),
+        llc_tag=jnp.full((B, s2, w2), -1, jnp.int32),
+        llc_owner=jnp.full((B, s2, w2), -1, jnp.int32),
+        llc_lru=jnp.zeros((B, s2, w2), jnp.int32),
+        sharers=jnp.zeros((B, s2, w2, nw), jnp.uint32),
+        quantum_end=jnp.asarray(cfg.quantum, jnp.int32),
+        step=jnp.asarray(0, jnp.int32),
+        counters=jnp.zeros((len(COUNTER_NAMES), C), jnp.int32),
+    )
+
+
+def counters_to_dict(counters: np.ndarray) -> dict[str, np.ndarray]:
+    return {k: np.asarray(counters[i], dtype=np.int64) for i, k in enumerate(COUNTER_NAMES)}

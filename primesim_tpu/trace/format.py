@@ -48,6 +48,8 @@ class Trace:
             mem = (t == EV_LD) | (t == EV_ST)
             if (events[:, :, 2][mem] < 0).any():
                 raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
+            if (events[:, :, 1][t == EV_INS] < 0).any():
+                raise ValueError("INS batch counts must be >= 0")
             if (lengths > events.shape[1]).any() or (lengths < 1).any():
                 raise ValueError("per-core lengths out of range")
             # every core's row must terminate: the event at lengths-1 is END

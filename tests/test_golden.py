@@ -6,10 +6,8 @@ to match the golden model bit-exactly (test_parity.py), so these tests anchor
 the whole fidelity story.
 """
 
-import numpy as np
-import pytest
 
-from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig, small_test_config
+from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
 from primesim_tpu.golden.sim import GoldenSim
 from primesim_tpu.trace.format import EV_INS, EV_LD, EV_ST, from_event_lists
 

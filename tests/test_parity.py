@@ -1,0 +1,114 @@
+"""Golden-vs-JAX bit-exact parity (SURVEY.md §4a — the core fidelity test).
+
+Every workload generator, several machine shapes: per-core cycles, trace
+pointers, all cache/directory state, and every stat counter must match the
+golden model EXACTLY.
+"""
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+from primesim_tpu.golden.sim import GoldenSim
+from primesim_tpu.trace import synth
+
+
+def machine(n_cores=8, **kw):
+    d = dict(
+        n_cores=n_cores,
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=8192, ways=4, line=64, latency=10),
+        n_banks=max(2, n_cores // 2),
+        noc=NocConfig(mesh_x=2, mesh_y=2, link_lat=1, router_lat=1),
+        dram_lat=100,
+        quantum=500,
+    )
+    d.update(kw)
+    return MachineConfig(**d)
+
+
+def assert_parity(cfg, trace, chunk_steps=64):
+    from primesim_tpu.sim.engine import Engine
+
+    g = GoldenSim(cfg, trace)
+    g.run()
+    e = Engine(cfg, trace, chunk_steps=chunk_steps)
+    e.run()
+
+    np.testing.assert_array_equal(e.cycles, g.cycles, err_msg="cycles")
+    np.testing.assert_array_equal(np.asarray(e.state.ptr), g.ptr, err_msg="ptr")
+    np.testing.assert_array_equal(np.asarray(e.state.l1_tag), g.l1_tag, err_msg="l1_tag")
+    np.testing.assert_array_equal(
+        np.asarray(e.state.l1_state), g.l1_state, err_msg="l1_state"
+    )
+    np.testing.assert_array_equal(np.asarray(e.state.llc_tag), g.llc_tag, err_msg="llc_tag")
+    np.testing.assert_array_equal(
+        np.asarray(e.state.llc_owner), g.llc_owner, err_msg="llc_owner"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(e.state.sharers), g.sharers, err_msg="sharers"
+    )
+    ec = e.counters
+    for k, v in g.counters.items():
+        np.testing.assert_array_equal(ec[k], v, err_msg=f"counter {k}")
+    # LRU parity (modulo int width): compare where entries are valid
+    np.testing.assert_array_equal(
+        np.asarray(e.state.l1_lru), g.l1_lru, err_msg="l1_lru"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(e.state.llc_lru), g.llc_lru, err_msg="llc_lru"
+    )
+
+
+GENS = {
+    "uniform_random": lambda n: synth.uniform_random(n, n_mem_ops=80, seed=11),
+    "stream": lambda n: synth.stream(n, n_mem_ops=60, seed=12),
+    "pointer_chase": lambda n: synth.pointer_chase(n, n_mem_ops=60, seed=13),
+    "false_sharing": lambda n: synth.false_sharing(n, n_mem_ops=60, seed=14),
+    "fft_like": lambda n: synth.fft_like(n, n_phases=2, points_per_core=12, seed=15),
+    "readers_writer": lambda n: synth.readers_writer(n, n_rounds=3, seed=16),
+}
+
+
+@pytest.mark.parametrize("gen", sorted(GENS))
+def test_parity_8core(gen):
+    cfg = machine(8)
+    assert_parity(cfg, GENS[gen](8))
+
+
+@pytest.mark.parametrize("gen", ["uniform_random", "false_sharing", "fft_like"])
+def test_parity_16core_small_quantum(gen):
+    # tiny quantum stresses the barrier; small LLC stresses back-invalidation
+    cfg = machine(
+        16,
+        n_banks=4,
+        llc=CacheConfig(size=2048, ways=2, line=64, latency=7),
+        noc=NocConfig(mesh_x=4, mesh_y=2, link_lat=2, router_lat=1),
+        quantum=64,
+    )
+    assert_parity(cfg, GENS[gen](16), chunk_steps=50)
+
+
+def test_parity_heterogeneous_cpi():
+    from primesim_tpu.config.machine import CoreConfig
+
+    cfg = machine(8)
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg, core=CoreConfig(cpi_per_core=tuple([1, 2] * 4))
+    )
+    assert_parity(cfg, GENS["uniform_random"](8))
+
+
+def test_parity_o3_overlap():
+    from primesim_tpu.config.machine import CoreConfig
+    import dataclasses
+
+    cfg = dataclasses.replace(machine(8), core=CoreConfig(cpi=1, o3_overlap_256=128))
+    assert_parity(cfg, GENS["fft_like"](8))
+
+
+def test_parity_single_core():
+    cfg = machine(1, n_banks=1, noc=NocConfig(mesh_x=1, mesh_y=1))
+    assert_parity(cfg, GENS["pointer_chase"](1))
