@@ -1,0 +1,83 @@
+"""Multi-chip sharding of the simulated machine over a jax device mesh.
+
+TPU-native replacement for the reference's MPI process topology (SURVEY.md
+§2 "Parallelism-strategy inventory"): where PriME splits the uncore across
+MPI ranks each owning LLC banks/directory slices, we lay the simulated
+machine out over a 1-D `jax.sharding.Mesh` axis ``"tiles"``:
+
+- core-axis arrays (clocks, trace pointers, private L1s, per-core counters,
+  the event stream) are sharded by core — each device simulates a sub-grid
+  of tiles' cores;
+- bank-axis arrays (LLC tags/owners/LRU, directory sharer words) are
+  sharded by bank over the same axis — each device owns a slice of the
+  LLC/directory, exactly like a PriME uncore rank.
+
+Cross-device traffic (a core's request to a remote home bank, probes and
+invalidations back to remote cores) is NOT hand-written message passing:
+the step function stays pure and global, and XLA's SPMD partitioner inserts
+the all-gathers/reduce-scatters that realize it over ICI (multi-host: DCN).
+The per-step `lax.scan` boundary doubles as the quantum barrier collective
+(SURVEY.md §2 #10 [DRIVER]).
+
+Works identically on real TPU meshes and on virtual CPU meshes
+(``--xla_force_host_platform_device_count``), which is how tests and the
+driver's `dryrun_multichip` validate multi-chip behavior without hardware.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..sim.state import MachineState
+
+AXIS = "tiles"
+
+
+def tile_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D device mesh over the tile axis (the only axis the sim needs:
+    cores and banks shard over the same tile sub-grids)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"tile_mesh: {n_devices} devices requested but only "
+                    f"{len(devices)} visible"
+                )
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def state_pspecs() -> MachineState:
+    """PartitionSpec per MachineState field (leading core/bank axis)."""
+    return MachineState(
+        cycles=P(AXIS),
+        ptr=P(AXIS),
+        l1_tag=P(AXIS),
+        l1_state=P(AXIS),
+        l1_lru=P(AXIS),
+        llc_tag=P(AXIS),
+        llc_owner=P(AXIS),
+        llc_lru=P(AXIS),
+        sharers=P(AXIS),
+        quantum_end=P(),
+        step=P(),
+        counters=P(None, AXIS),
+    )
+
+
+def events_pspec() -> P:
+    return P(AXIS)  # events[C, T, 3] sharded by core
+
+
+def shard_state(mesh: Mesh, st: MachineState) -> MachineState:
+    specs = state_pspecs()
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), st, specs
+    )
+
+
+def shard_events(mesh: Mesh, events) -> jax.Array:
+    return jax.device_put(events, NamedSharding(mesh, events_pspec()))

@@ -357,12 +357,25 @@ class Engine:
     arithmetic) so int32 never overflows.
     """
 
-    def __init__(self, cfg: MachineConfig, trace: Trace, chunk_steps: int = 256):
+    def __init__(
+        self,
+        cfg: MachineConfig,
+        trace: Trace,
+        chunk_steps: int = 256,
+        mesh=None,
+    ):
         assert trace.n_cores == cfg.n_cores
         self.cfg = cfg
         self.trace = trace
         self.events = jnp.asarray(trace.events)
         self.state = init_state(cfg)
+        self.mesh = mesh
+        if mesh is not None:
+            # multi-chip: lay cores/banks out over the tile axis (parallel/)
+            from ..parallel.sharding import shard_events, shard_state
+
+            self.events = shard_events(mesh, self.events)
+            self.state = shard_state(mesh, self.state)
         self.chunk_steps = chunk_steps
         self.cycle_base = np.int64(0)
         self.host_counters = zero_counters(cfg.n_cores)
@@ -376,12 +389,13 @@ class Engine:
             counters=jnp.zeros_like(self.state.counters)
         )
 
+    def _event_types_at_ptr(self) -> np.ndarray:
+        p = np.minimum(np.asarray(self.state.ptr), self.trace.max_len - 1)
+        return self.trace.events[np.arange(self.cfg.n_cores), p, 0]
+
     def _rebase(self) -> None:
         cyc = np.asarray(self.state.cycles)
-        et = np.asarray(self.events[np.arange(self.cfg.n_cores),
-                                    np.minimum(np.asarray(self.state.ptr),
-                                               self.trace.max_len - 1), 0])
-        nd = et != EV_END
+        nd = self._event_types_at_ptr() != EV_END
         if not nd.any():
             return
         delta = (int(cyc[nd].min()) // self.cfg.quantum) * self.cfg.quantum
@@ -394,9 +408,7 @@ class Engine:
         )
 
     def done(self) -> bool:
-        p = np.minimum(np.asarray(self.state.ptr), self.trace.max_len - 1)
-        et = self.trace.events[np.arange(self.cfg.n_cores), p, 0]
-        return bool((et == EV_END).all())
+        return bool((self._event_types_at_ptr() == EV_END).all())
 
     def run(self, max_steps: int = 10_000_000) -> None:
         while self.steps_run < max_steps and not self.done():

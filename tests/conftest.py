@@ -1,13 +1,28 @@
-"""Test environment: force an 8-device virtual CPU mesh BEFORE jax imports.
+"""Test environment: force an 8-device virtual CPU mesh.
 
 This is the single-host stand-in for multi-chip TPU (SURVEY.md §4d): all
 sharding/shard_map logic is exercised on 8 virtual CPU devices; the driver
 separately dry-run-compiles the multi-chip path via __graft_entry__.
+
+NOTE: this image's sitecustomize pre-imports jax with the `axon` TPU
+platform at interpreter startup, so env vars alone are too late — we must
+set XLA_FLAGS (read lazily at CPU-client creation) and then switch the
+platform through jax.config before any backend is touched.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if len(jax.devices()) != 8:
+    raise RuntimeError(
+        f"tests need an 8-device virtual CPU mesh, got {jax.devices()}; "
+        f"XLA_FLAGS={os.environ.get('XLA_FLAGS')!r} already carried a "
+        "conflicting xla_force_host_platform_device_count?"
+    )
