@@ -5,8 +5,9 @@ TPU-native replacement for the reference's XML config layer (SURVEY.md §2 #11:
 Typed dataclasses are the source of truth; `primesim_tpu.config.xml_compat`
 loads reference-schema XML files into these for A/B parity runs.
 
-All latencies are integer cycles. All geometry fields that index arrays are
-powers of two so the vectorized engine can use mask arithmetic.
+All latencies are integer cycles. Geometry fields used in mask arithmetic
+(bank count, cache sets, line size) must be powers of two; the core count
+may be arbitrary (heterogeneous big.LITTLE mixes, odd device meshes).
 """
 
 from __future__ import annotations
@@ -109,8 +110,8 @@ class MachineConfig:
         self.validate()
 
     def validate(self) -> None:
-        if not _is_pow2(self.n_cores):
-            raise ValueError("n_cores must be a power of two")
+        if self.n_cores < 1:
+            raise ValueError("n_cores must be >= 1")
         if not _is_pow2(self.n_banks):
             raise ValueError("n_banks must be a power of two")
         self.core.validate()
@@ -173,7 +174,7 @@ def small_test_config(n_cores: int = 4, **kw) -> MachineConfig:
         n_cores=n_cores,
         l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
         llc=CacheConfig(size=4096, ways=4, line=64, latency=10),
-        n_banks=min(4, n_cores),
+        n_banks=1 << (min(4, n_cores).bit_length() - 1),  # pow2 <= min(4, n)
         noc=NocConfig(mesh_x=2, mesh_y=2, link_lat=1, router_lat=1),
         dram_lat=100,
         quantum=1000,

@@ -120,12 +120,20 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     llc_miss = winner & ~jnp.any(llc_match, axis=1)
 
     owner = st.llc_owner[bank, bset, llc_hway]  # [C]
-    shw = st.sharers[bank, bset, llc_hway]  # [C, NW]
+    # one contiguous row gather serves both the hit way and the victim way
+    sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]
+    shw = jnp.take_along_axis(sh_rows, llc_hway[:, None, None], axis=1)[:, 0]
 
-    # unpack sharer bits into a [winner, target] matrix
+    # unpack sharer bits into a [winner, target] matrix — elementwise bit
+    # unpack + reshape, NOT a [C,C] element gather (TPU gathers are slow)
     word_idx = arange_c // 32  # [C] target -> word
     bit_idx = (arange_c % 32).astype(jnp.uint32)
-    sh_bits = ((shw[:, word_idx] >> bit_idx[None, :]) & jnp.uint32(1)).astype(jnp.bool_)
+
+    def unpack_bits(words):  # [C, NW] uint32 -> [C, C] bool (first C targets)
+        b = (words[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]) & 1
+        return b.reshape(C, NW * 32)[:, :C] != 0
+
+    sh_bits = unpack_bits(shw)
     sh_bits = sh_bits & (arange_c[None, :] != arange_c[:, None])  # exclude self
 
     # per-pair round-trip latency/hops from home bank to target core
@@ -166,11 +174,9 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     llc_vway = jnp.argmin(vkey, axis=1).astype(jnp.int32)
     vic_tag = llc_tag_rows[arange_c, llc_vway]
     vic_owner = st.llc_owner[bank, bset, llc_vway]
-    vic_shw = st.sharers[bank, bset, llc_vway]
+    vic_shw = jnp.take_along_axis(sh_rows, llc_vway[:, None, None], axis=1)[:, 0]
     vic_valid = llc_miss & (vic_tag != -1)
-    vic_sh_bits = ((vic_shw[:, word_idx] >> bit_idx[None, :]) & jnp.uint32(1)).astype(
-        jnp.bool_
-    )
+    vic_sh_bits = unpack_bits(vic_shw)
     # back-inv targets: recorded sharers plus the owner (golden adds owner
     # to vtargets when not already recorded as a sharer)
     vic_owner_bit = (arange_c[None, :] == vic_owner[:, None]) & (vic_owner >= 0)[:, None]
@@ -240,12 +246,22 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         jnp.where(is_ins, earg, 0) + (hit | winner).astype(jnp.int32),
     )
 
-    # L1 hit refresh (+ silent E->M)
-    hrow = jnp.where(hit, arange_c, C)  # OOB-drop for non-hit lanes
-    l1_lru = st.l1_lru.at[hrow, l1s, hit_way].set(step_no, mode="drop")
-    l1_state = st.l1_state.at[
-        jnp.where(write_hit, arange_c, C), l1s, hit_way
-    ].set(M, mode="drop")
+    # All state updates below are branchless gather/where rewrites, NOT
+    # jnp scatters: XLA lowers multi-update scatters on TPU poorly (they can
+    # serialize), while masked full-array selects vectorize. The only real
+    # scatter in the step is the phase-2 arbitration table, whose winning
+    # key doubles as a slot->winner-lane map (key % C = core id), so every
+    # consumer can gather instead of scattering.
+    widx_slot = jnp.where(table == INT32_MAX, C, table % C)  # [B*S2] -> lane
+
+    # L1 hit refresh (+ silent E->M): row index is the core itself, so the
+    # update is a [C,S1,W1] one-hot select
+    set1h = jnp.arange(S1, dtype=jnp.int32)[None, :] == l1s[:, None]  # [C,S1]
+    way_hit1h = jnp.arange(W1, dtype=jnp.int32)[None, :] == hit_way[:, None]
+    sel_hit = hit[:, None, None] & set1h[:, :, None] & way_hit1h[:, None, :]
+    l1_lru = jnp.where(sel_hit, step_no, st.l1_lru)
+    sel_whit = write_hit[:, None, None] & set1h[:, :, None] & way_hit1h[:, None, :]
+    l1_state = jnp.where(sel_whit, M, st.l1_state)
     l1_tag = st.l1_tag
 
     # winner L1 update: UPG-in-place vs fill
@@ -255,17 +271,20 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
     cnt = cadd(cnt, "l1_writebacks", fill & (state_rows[arange_c, l1_vway] == M))
     upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
-    wrow = jnp.where(winner, arange_c, C)
-    l1_tag = l1_tag.at[wrow, l1s, upd_way].set(line, mode="drop")
-    l1_state = l1_state.at[wrow, l1s, upd_way].set(grant, mode="drop")
-    l1_lru = l1_lru.at[wrow, l1s, upd_way].set(step_no, mode="drop")
+    way_upd1h = jnp.arange(W1, dtype=jnp.int32)[None, :] == upd_way[:, None]
+    sel_w = winner[:, None, None] & set1h[:, :, None] & way_upd1h[:, None, :]
+    l1_tag = jnp.where(sel_w, line[:, None, None], l1_tag)
+    l1_state = jnp.where(sel_w, grant[:, None, None], l1_state)
+    l1_lru = jnp.where(sel_w, step_no, l1_lru)
 
-    # LLC entry update (one winner per (bank,set) -> collision-free)
+    # LLC entry update: scatter the C winners' rows (collision-free: one
+    # winner per (bank,set)) — scattering C updates beats gathering for all
+    # B*S2 slots on TPU
     llc_uway = jnp.where(llc_hit, llc_hway, llc_vway)
+    new_owner = jnp.where(write_w | gets_excl_hit | llc_miss, arange_c, -1)
     wbank = jnp.where(winner, bank, B)
     llc_tag_n = st.llc_tag.at[wbank, bset, llc_uway].set(line, mode="drop")
     llc_lru_n = st.llc_lru.at[wbank, bset, llc_uway].set(step_no, mode="drop")
-    new_owner = jnp.where(write_w | gets_excl_hit | llc_miss, arange_c, -1)
     llc_owner_n = st.llc_owner.at[wbank, bset, llc_uway].set(new_owner, mode="drop")
 
     # new sharer words [C, NW]
@@ -287,38 +306,67 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
             jnp.zeros_like(shw),  # M grants, E grants, misses: cleared
         ),
     )
-    sharers_n = st.sharers.at[wbank, bset, llc_uway].set(new_shw, mode="drop")
+    # rewrite only the winner's way segment within its row, scatter the row
+    way_seg = (
+        jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_uway[:, None]
+    )
+    new_row = jnp.where(
+        way_seg,
+        jnp.broadcast_to(new_shw[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
+        sh_rows.reshape(C, W2 * NW),
+    )
+    wslot = jnp.where(winner, slot, B * S2)
+    sharers_n = st.sharers.at[wslot].set(new_row, mode="drop")
 
     # ---- phase 4.B: remote ops, tag-conditional against post-A state -----
-    # (1) request-line ops: owner probe (downgrade/invalidate) + sharer invs
-    dn_pairs = (arange_c[None, :] == oclamp[:, None]) & (gets_probe)[:, None]
-    oi_pairs = (arange_c[None, :] == oclamp[:, None]) & (write_probe)[:, None]
-    reqline_pairs = dn_pairs | oi_pairs | inv_pairs
-    downgrade_pairs = dn_pairs & ~oi_pairs & ~inv_pairs
+    # Rather than materializing [winner, target, way] pair tensors (O(C^2 W1),
+    # the old hot spot), scatter each winner's remote-op descriptor into a
+    # per-(bank,set) table (collision-free: one winner per slot) and let every
+    # L1 way gather its own slot's descriptor — O(C * S1 * W1) total. Golden
+    # semantics preserved exactly: ops apply only to *recorded* sharers/owner
+    # (not actual holders), and only if the way still holds the line post-A.
+    #   op bit 0: invalidate recorded sharers excl. self  (GETM/UPG, LLC hit)
+    #   op bit 1: invalidate recorded owner               (write probe)
+    #   op bit 2: downgrade recorded owner E/M -> S       (GETS probe)
+    #   op bit 3: back-invalidate victim sharers + owner  (LLC-miss eviction)
+    # Hit-path ops target `line`; miss-path back-inv targets `vic_tag` — both
+    # live in the same (bank,set) slot, and a winner is either hit or miss,
+    # so one descriptor per slot suffices.
+    remote_line = jnp.where(llc_miss, vic_tag, line)
+    remote_owner = jnp.where(llc_miss, vic_owner, owner)
+    remote_sh = jnp.where(llc_miss[:, None], vic_shw, shw)  # [C, NW] recorded
+    ops_packed = (
+        (write_w & llc_hit).astype(jnp.int32)
+        + 2 * write_probe.astype(jnp.int32)
+        + 4 * gets_probe.astype(jnp.int32)
+        + 8 * vic_valid.astype(jnp.int32)
+    )
 
-    def apply_remote(l1_tag, l1_state, pairs, dgrade, pline):
-        # pairs: [C(winner i), C(target j)]; pline: [C] line per winner
-        s_i = pline & (S1 - 1)  # [C]
-        tgt_tags = l1_tag[arange_c[None, :], s_i[:, None]]  # [C, C, W1]
-        tgt_states = l1_state[arange_c[None, :], s_i[:, None]]
-        m = (tgt_tags == pline[:, None, None]) & (tgt_states != I)
-        has = jnp.any(m, axis=2) & pairs
-        way = jnp.argmax(m, axis=2).astype(jnp.int32)
-        j = jnp.broadcast_to(arange_c[None, :], (C, C))
-        sfull = jnp.broadcast_to(s_i[:, None], (C, C))
-        cur = tgt_states[jnp.arange(C)[:, None], jnp.arange(C)[None, :], way]
-        newv = jnp.where(
-            dgrade, jnp.where(cur >= E, S, cur), I
-        )  # downgrade E/M->S else invalidate
-        jf = jnp.where(has, j, C).reshape(-1)
-        return l1_state.at[jf, sfull.reshape(-1), way.reshape(-1)].set(
-            newv.reshape(-1), mode="drop"
+    t = l1_tag  # [C, S1, W1], post-phase-A
+    tslot = (t & (B - 1)) * S2 + ((t >> (B.bit_length() - 1)) & (S2 - 1))
+    widx3 = widx_slot[tslot]  # [C,S1,W1] winner lane (or C) at this way's slot
+
+    def wg(a, fill):
+        pad = jnp.concatenate(
+            [a, jnp.full((1,) + a.shape[1:], fill, a.dtype)], axis=0
         )
+        return pad[widx3]
 
-    l1_state = apply_remote(l1_tag, l1_state, reqline_pairs, downgrade_pairs, line)
-    # (2) back-invalidations for the LLC victim line
-    l1_state = apply_remote(
-        l1_tag, l1_state, back_pairs, jnp.zeros_like(back_pairs), vic_tag
+    ops = wg(ops_packed, 0)
+    line_m = (wg(remote_line, -1) == t) & (l1_state != I)
+    j3 = arange_c[:, None, None]
+    owner_m = wg(remote_owner, -1) == j3
+    not_self = widx3 != j3
+    shw_pad = jnp.concatenate([remote_sh, jnp.zeros((1, NW), jnp.uint32)], axis=0)
+    shbit = ((shw_pad[widx3, j3 >> 5] >> (j3 & 31).astype(jnp.uint32)) & 1) != 0
+    inv3 = line_m & (
+        (((ops & 1) != 0) & shbit & not_self)
+        | (((ops & 2) != 0) & owner_m)
+        | (((ops & 8) != 0) & (shbit | owner_m))
+    )
+    dn3 = line_m & ((ops & 4) != 0) & owner_m
+    l1_state = jnp.where(
+        inv3, I, jnp.where(dn3 & (l1_state >= E), S, l1_state)
     )
 
     return MachineState(

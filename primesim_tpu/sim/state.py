@@ -34,7 +34,14 @@ class MachineState(NamedTuple):
     llc_tag: jnp.ndarray  # [B, S2, W2] int32, -1 = invalid
     llc_owner: jnp.ndarray  # [B, S2, W2] int32 core id or -1
     llc_lru: jnp.ndarray  # [B, S2, W2] int32 step-stamp
-    sharers: jnp.ndarray  # [B, S2, W2, NW] uint32 packed sharer bits
+    # Directory sharer bit-vectors, stored row-per-(bank,set) with the way
+    # axis folded into columns: row slot b*S2+s, columns [w*NW, (w+1)*NW).
+    # Kept 2D so XLA settles on ONE layout for it — the natural
+    # [B,S2,W2,NW] shape made layout assignment bounce this (huge, at large
+    # core counts) array between gather- and loop-carry-preferred layouts,
+    # costing two full copies per step. (At the 1024-core flagship config
+    # the minor dim is also a 128 multiple, which tiles without padding.)
+    sharers: jnp.ndarray  # [B*S2, W2*NW] uint32 packed sharer bits
     # global clocks
     quantum_end: jnp.ndarray  # [] int32
     step: jnp.ndarray  # [] int32
@@ -61,7 +68,7 @@ def init_state(cfg: MachineConfig) -> MachineState:
         llc_tag=jnp.full((B, s2, w2), -1, jnp.int32),
         llc_owner=jnp.full((B, s2, w2), -1, jnp.int32),
         llc_lru=jnp.zeros((B, s2, w2), jnp.int32),
-        sharers=jnp.zeros((B, s2, w2, nw), jnp.uint32),
+        sharers=jnp.zeros((B * s2, w2 * nw), jnp.uint32),
         quantum_end=jnp.asarray(cfg.quantum, jnp.int32),
         step=jnp.asarray(0, jnp.int32),
         counters=jnp.zeros((len(COUNTER_NAMES), C), jnp.int32),

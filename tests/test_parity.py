@@ -45,8 +45,11 @@ def assert_parity(cfg, trace, chunk_steps=64):
     np.testing.assert_array_equal(
         np.asarray(e.state.llc_owner), g.llc_owner, err_msg="llc_owner"
     )
+    # engine stores sharers row-per-(bank,set) with ways folded into columns
     np.testing.assert_array_equal(
-        np.asarray(e.state.sharers), g.sharers, err_msg="sharers"
+        np.asarray(e.state.sharers).reshape(g.sharers.shape),
+        g.sharers,
+        err_msg="sharers",
     )
     ec = e.counters
     for k, v in g.counters.items():
@@ -112,3 +115,10 @@ def test_parity_o3_overlap():
 def test_parity_single_core():
     cfg = machine(1, n_banks=1, noc=NocConfig(mesh_x=1, mesh_y=1))
     assert_parity(cfg, GENS["pointer_chase"](1))
+
+
+def test_parity_non_pow2_cores():
+    # non-pow2 core counts are legal (big.LITTLE mixes, odd device meshes);
+    # only banks/sets/line need pow2 mask arithmetic
+    cfg = machine(12, n_banks=4)
+    assert_parity(cfg, GENS["false_sharing"](12))
