@@ -79,14 +79,57 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     is_mem = active & ((et == EV_LD) | is_st_ev)
 
     # ---- phase 1: L1 lookup + classification (step-start state) ----------
+    # PULL-BASED COHERENCE (the TPU-native shape of MESI): remote
+    # invalidations and downgrades are never pushed into target L1 arrays —
+    # that costs O(C * S1 * W1) table gathers per step. Instead each L1 way
+    # stores only locally-written state, and its EFFECTIVE MESI state is
+    # derived on access by validating against the directory (which phase 4
+    # maintains exactly):
+    #     no local entry, or line absent from LLC          -> I
+    #     directory owner == this core                     -> local state
+    #     this core recorded in the sharer bit-vector      -> S  (covers
+    #                                          probe-downgraded old owners)
+    #     otherwise                                        -> I  (stale)
+    # This is observably equivalent to eager invalidation (same hits,
+    # misses, victims, timings, counters) because every eager invalidation
+    # event is exactly a directory update that this validation re-derives;
+    # the eager golden model + parity tests prove the equivalence on every
+    # workload. See DESIGN.md §7.
     line = eaddr >> cfg.line_bits  # [C] int32 (addresses < 2^31)
     l1s = line & (S1 - 1)
-    tag_rows = st.l1_tag[arange_c, l1s]  # [C, W1]
-    state_rows = st.l1_state[arange_c, l1s]  # [C, W1]
-    l1_match = (tag_rows == line[:, None]) & (state_rows != I)
+    # L1 arrays are [C, W1*S1] (column w*S1 + s); pull the accessed set's
+    # per-way columns
+    w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]  # [C,W1]
+    tag_rows = jnp.take_along_axis(st.l1_tag, w1cols, axis=1)  # [C, W1]
+    state_rows = jnp.take_along_axis(st.l1_state, w1cols, axis=1)
+    logB = B.bit_length() - 1
+    n_slots = B * S2
+
+    # validate every resident way of the accessed set against the directory
+    ltag2 = st.llc_tag.reshape(n_slots, W2)
+    lown2 = st.llc_owner.reshape(n_slots, W2)
+    wslot = (tag_rows & (B - 1)) * S2 + ((tag_rows >> logB) & (S2 - 1))  # [C,W1]
+    wllc_tags = ltag2[wslot]  # [C, W1, W2]
+    wmatch = wllc_tags == tag_rows[..., None]
+    whas = jnp.any(wmatch, axis=2)
+    whway = jnp.argmax(wmatch, axis=2).astype(jnp.int32)
+    wowner = jnp.take_along_axis(lown2[wslot], whway[..., None], axis=2)[..., 0]
+    wsh_word = st.sharers[wslot, whway * NW + (arange_c[:, None] >> 5)]  # [C,W1]
+    wshbit = ((wsh_word >> (arange_c[:, None] & 31).astype(jnp.uint32)) & 1) != 0
+    weff = jnp.where(
+        (state_rows == I) | ~whas,
+        I,
+        jnp.where(
+            wowner == arange_c[:, None],
+            state_rows,
+            jnp.where(wshbit, S, I),
+        ),
+    )  # [C, W1] effective MESI per way
+
+    l1_match = (tag_rows == line[:, None]) & (weff != I)
     hit_any = jnp.any(l1_match, axis=1)
     hit_way = jnp.argmax(l1_match, axis=1).astype(jnp.int32)
-    hit_state = state_rows[arange_c, hit_way]
+    hit_state = weff[arange_c, hit_way]
 
     read_hit = is_mem & ~is_st_ev & hit_any
     write_hit = is_mem & is_st_ev & hit_any & (hit_state >= E)
@@ -146,8 +189,8 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     po_lat, po_hops = _one_way(btile, otile, cfg)  # bank -> owner (symmetric back)
 
     # does the owner actually still hold the line? (lazy directory, GETS)
-    own_tag_rows = st.l1_tag[oclamp, l1s]  # [C, W1]
-    own_state_rows = st.l1_state[oclamp, l1s]
+    own_tag_rows = st.l1_tag[oclamp[:, None], w1cols]  # [C, W1]
+    own_state_rows = st.l1_state[oclamp[:, None], w1cols]
     own_found = jnp.any((own_tag_rows == line[:, None]) & (own_state_rows != I), axis=1)
 
     is_write_req = getm | upg
@@ -246,35 +289,42 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         jnp.where(is_ins, earg, 0) + (hit | winner).astype(jnp.int32),
     )
 
-    # All state updates below are branchless gather/where rewrites, NOT
-    # jnp scatters: XLA lowers multi-update scatters on TPU poorly (they can
-    # serialize), while masked full-array selects vectorize. The only real
-    # scatter in the step is the phase-2 arbitration table, whose winning
-    # key doubles as a slot->winner-lane map (key % C = core id), so every
-    # consumer can gather instead of scattering.
-    widx_slot = jnp.where(table == INT32_MAX, C, table % C)  # [B*S2] -> lane
+    # L1-side updates are branchless one-hot selects (row index = own core);
+    # LLC-side updates scatter one row per winner (collision-free).
 
     # L1 hit refresh (+ silent E->M): row index is the core itself, so the
     # update is a [C,S1,W1] one-hot select
-    set1h = jnp.arange(S1, dtype=jnp.int32)[None, :] == l1s[:, None]  # [C,S1]
-    way_hit1h = jnp.arange(W1, dtype=jnp.int32)[None, :] == hit_way[:, None]
-    sel_hit = hit[:, None, None] & set1h[:, :, None] & way_hit1h[:, None, :]
+    # (L1 arrays are [C, W1*S1]: column = way*S1 + set)
+    colr = jnp.arange(W1 * S1, dtype=jnp.int32)[None, :]  # [1, W1*S1]
+    set_sel = (colr % S1) == l1s[:, None]  # [C, W1*S1] this-set columns
+    hitway_sel = set_sel & ((colr // S1) == hit_way[:, None])
+    sel_hit = hit[:, None] & hitway_sel
     l1_lru = jnp.where(sel_hit, step_no, st.l1_lru)
-    sel_whit = write_hit[:, None, None] & set1h[:, :, None] & way_hit1h[:, None, :]
-    l1_state = jnp.where(sel_whit, M, st.l1_state)
+    l1_state = jnp.where(write_hit[:, None] & hitway_sel, M, st.l1_state)
     l1_tag = st.l1_tag
 
-    # winner L1 update: UPG-in-place vs fill
-    upg_in_place = (upg & winner) & hit_any
+    # winner L1 update: UPG-in-place vs fill. Victim preference counts
+    # directory-invalidated (stale) ways as free, matching eager-MESI's
+    # invalid-first rule; the victim writeback fires only on EFFECTIVE M.
+    upg_in_place = upg & winner  # upg requires an L1 hit: always in-place
     fill = winner & ~upg_in_place
-    l1_vkey = jnp.where(state_rows == I, -1, st.l1_lru[arange_c, l1s])
+    lru_rows = jnp.take_along_axis(st.l1_lru, w1cols, axis=1)  # [C, W1]
+    l1_vkey = jnp.where(weff == I, -1, lru_rows)
     l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
-    cnt = cadd(cnt, "l1_writebacks", fill & (state_rows[arange_c, l1_vway] == M))
+    cnt = cadd(cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M))
     upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
-    way_upd1h = jnp.arange(W1, dtype=jnp.int32)[None, :] == upd_way[:, None]
-    sel_w = winner[:, None, None] & set1h[:, :, None] & way_upd1h[:, None, :]
-    l1_tag = jnp.where(sel_w, line[:, None, None], l1_tag)
-    l1_state = jnp.where(sel_w, grant[:, None, None], l1_state)
+    updway_sel = set_sel & ((colr // S1) == upd_way[:, None])
+    sel_w = winner[:, None] & updway_sel
+    # a fill may duplicate a stale way's tag: clear the stale copy so tags
+    # stay unique per set (else the refill could "resurrect" it, since the
+    # directory once again records this core for the line)
+    dup2 = (
+        fill[:, None] & set_sel & (l1_tag == line[:, None]) & ~updway_sel
+    )
+    l1_tag = jnp.where(dup2, -1, l1_tag)
+    l1_state = jnp.where(dup2, I, l1_state)
+    l1_tag = jnp.where(sel_w, line[:, None], l1_tag)
+    l1_state = jnp.where(sel_w, grant[:, None], l1_state)
     l1_lru = jnp.where(sel_w, step_no, l1_lru)
 
     # LLC entry update: scatter the C winners' rows (collision-free: one
@@ -315,59 +365,12 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         jnp.broadcast_to(new_shw[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
         sh_rows.reshape(C, W2 * NW),
     )
-    wslot = jnp.where(winner, slot, B * S2)
-    sharers_n = st.sharers.at[wslot].set(new_row, mode="drop")
+    wslot_upd = jnp.where(winner, slot, B * S2)
+    sharers_n = st.sharers.at[wslot_upd].set(new_row, mode="drop")
 
-    # ---- phase 4.B: remote ops, tag-conditional against post-A state -----
-    # Rather than materializing [winner, target, way] pair tensors (O(C^2 W1),
-    # the old hot spot), scatter each winner's remote-op descriptor into a
-    # per-(bank,set) table (collision-free: one winner per slot) and let every
-    # L1 way gather its own slot's descriptor — O(C * S1 * W1) total. Golden
-    # semantics preserved exactly: ops apply only to *recorded* sharers/owner
-    # (not actual holders), and only if the way still holds the line post-A.
-    #   op bit 0: invalidate recorded sharers excl. self  (GETM/UPG, LLC hit)
-    #   op bit 1: invalidate recorded owner               (write probe)
-    #   op bit 2: downgrade recorded owner E/M -> S       (GETS probe)
-    #   op bit 3: back-invalidate victim sharers + owner  (LLC-miss eviction)
-    # Hit-path ops target `line`; miss-path back-inv targets `vic_tag` — both
-    # live in the same (bank,set) slot, and a winner is either hit or miss,
-    # so one descriptor per slot suffices.
-    remote_line = jnp.where(llc_miss, vic_tag, line)
-    remote_owner = jnp.where(llc_miss, vic_owner, owner)
-    remote_sh = jnp.where(llc_miss[:, None], vic_shw, shw)  # [C, NW] recorded
-    ops_packed = (
-        (write_w & llc_hit).astype(jnp.int32)
-        + 2 * write_probe.astype(jnp.int32)
-        + 4 * gets_probe.astype(jnp.int32)
-        + 8 * vic_valid.astype(jnp.int32)
-    )
-
-    t = l1_tag  # [C, S1, W1], post-phase-A
-    tslot = (t & (B - 1)) * S2 + ((t >> (B.bit_length() - 1)) & (S2 - 1))
-    widx3 = widx_slot[tslot]  # [C,S1,W1] winner lane (or C) at this way's slot
-
-    def wg(a, fill):
-        pad = jnp.concatenate(
-            [a, jnp.full((1,) + a.shape[1:], fill, a.dtype)], axis=0
-        )
-        return pad[widx3]
-
-    ops = wg(ops_packed, 0)
-    line_m = (wg(remote_line, -1) == t) & (l1_state != I)
-    j3 = arange_c[:, None, None]
-    owner_m = wg(remote_owner, -1) == j3
-    not_self = widx3 != j3
-    shw_pad = jnp.concatenate([remote_sh, jnp.zeros((1, NW), jnp.uint32)], axis=0)
-    shbit = ((shw_pad[widx3, j3 >> 5] >> (j3 & 31).astype(jnp.uint32)) & 1) != 0
-    inv3 = line_m & (
-        (((ops & 1) != 0) & shbit & not_self)
-        | (((ops & 2) != 0) & owner_m)
-        | (((ops & 8) != 0) & (shbit | owner_m))
-    )
-    dn3 = line_m & ((ops & 4) != 0) & owner_m
-    l1_state = jnp.where(
-        inv3, I, jnp.where(dn3 & (l1_state >= E), S, l1_state)
-    )
+    # No phase 4.B: under pull-based coherence, the directory updates above
+    # ARE the invalidations/downgrades — remote L1s re-derive their state on
+    # their next access (phase 1 validation).
 
     return MachineState(
         cycles=cycles,

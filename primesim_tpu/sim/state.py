@@ -26,10 +26,15 @@ class MachineState(NamedTuple):
     # core (CoreManager)
     cycles: jnp.ndarray  # [C] int32 — per-core clock (epoch-relative)
     ptr: jnp.ndarray  # [C] int32 — next trace event index
-    # L1 (private caches)
-    l1_tag: jnp.ndarray  # [C, S1, W1] int32, -1 = invalid
-    l1_state: jnp.ndarray  # [C, S1, W1] int32 MESI
-    l1_lru: jnp.ndarray  # [C, S1, W1] int32 step-stamp
+    # L1 (private caches). Stored 2D [C, W1*S1] (way-major columns,
+    # column w*S1 + s): with a 3D shape XLA's layout assignment insists on
+    # making the small way dimension minor, and TPU tiling pads the minor
+    # dim to 128 — a 32x memory/bandwidth waste at W1=4. A 2D row of
+    # W1*S1 (>= 512) columns tiles cleanly and leaves XLA nothing to
+    # re-layout.
+    l1_tag: jnp.ndarray  # [C, W1*S1] int32, -1 = invalid
+    l1_state: jnp.ndarray  # [C, W1*S1] int32 MESI (locally-written)
+    l1_lru: jnp.ndarray  # [C, W1*S1] int32 step-stamp
     # LLC banks + directory
     llc_tag: jnp.ndarray  # [B, S2, W2] int32, -1 = invalid
     llc_owner: jnp.ndarray  # [B, S2, W2] int32 core id or -1
@@ -62,9 +67,9 @@ def init_state(cfg: MachineConfig) -> MachineState:
     return MachineState(
         cycles=jnp.zeros(C, jnp.int32),
         ptr=jnp.zeros(C, jnp.int32),
-        l1_tag=jnp.full((C, s1, w1), -1, jnp.int32),
-        l1_state=jnp.full((C, s1, w1), I, jnp.int32),
-        l1_lru=jnp.zeros((C, s1, w1), jnp.int32),
+        l1_tag=jnp.full((C, w1 * s1), -1, jnp.int32),
+        l1_state=jnp.full((C, w1 * s1), I, jnp.int32),
+        l1_lru=jnp.zeros((C, w1 * s1), jnp.int32),
         llc_tag=jnp.full((B, s2, w2), -1, jnp.int32),
         llc_owner=jnp.full((B, s2, w2), -1, jnp.int32),
         llc_lru=jnp.zeros((B, s2, w2), jnp.int32),

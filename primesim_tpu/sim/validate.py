@@ -1,0 +1,70 @@
+"""Observable-state helpers for the pull-based engine (NumPy, host-side).
+
+The vectorized engine keeps only locally-written L1 state and derives each
+way's effective MESI state from the directory on access (engine.py phase 1).
+`effective_l1_state` re-derives that mapping on host arrays so tests and
+debug invariants can compare the engine's *observable* cache contents
+against the eager golden model bit-for-bit: at every (core, set, way) the
+golden's eagerly-maintained state must equal the engine's derived state,
+and tags must agree wherever the golden holds a valid line.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config.machine import MachineConfig
+from .state import E, I, M, S  # noqa: F401  (shared MESI encoding)
+
+
+def engine_l1_to_golden(cfg: MachineConfig, arr: np.ndarray) -> np.ndarray:
+    """Reshape an engine L1 array [C, W1*S1] to golden layout [C, S1, W1]."""
+    C = arr.shape[0]
+    W1, S1 = cfg.l1.ways, cfg.l1.sets
+    return np.transpose(arr.reshape(C, W1, S1), (0, 2, 1))
+
+
+def effective_l1_state(
+    cfg: MachineConfig,
+    l1_tag: np.ndarray,  # [C, W1*S1] (engine layout, way-major columns)
+    l1_state: np.ndarray,  # [C, W1*S1] locally-written MESI
+    llc_tag: np.ndarray,  # [B, S2, W2]
+    llc_owner: np.ndarray,  # [B, S2, W2]
+    sharers: np.ndarray,  # [B*S2, W2*NW] packed rows (engine layout)
+) -> np.ndarray:
+    """Directory-validated MESI state per L1 way (engine phase-1 rule).
+
+    Accepts the engine's flattened way-major L1 layout and returns the
+    validated states in the golden model's [C, S1, W1] layout.
+    """
+    l1_tag = engine_l1_to_golden(cfg, l1_tag)
+    l1_state = engine_l1_to_golden(cfg, l1_state)
+    C, S1, W1 = l1_tag.shape
+    B, S2, W2 = llc_tag.shape
+    NW = cfg.n_sharer_words
+    logB = B.bit_length() - 1
+
+    ltag2 = llc_tag.reshape(B * S2, W2)
+    lown2 = llc_owner.reshape(B * S2, W2)
+    sh3 = sharers.reshape(B * S2, W2, NW)
+
+    slot = (l1_tag & (B - 1)) * S2 + ((l1_tag >> logB) & (S2 - 1))  # [C,S1,W1]
+    tags = ltag2[slot]  # [C,S1,W1,W2]
+    match = tags == l1_tag[..., None]
+    has = match.any(-1)
+    hway = match.argmax(-1)
+    owner = np.take_along_axis(lown2[slot], hway[..., None], -1)[..., 0]
+    cores = np.arange(C, dtype=np.int64)[:, None, None]
+    word = np.take_along_axis(
+        sh3[slot],  # [C,S1,W1,W2,NW]
+        np.broadcast_to((cores >> 5), slot.shape)[..., None, None],
+        -1,
+    )[..., 0]  # [C,S1,W1,W2]
+    shword = np.take_along_axis(word, hway[..., None], -1)[..., 0]
+    shbit = ((shword >> (cores & 31).astype(np.uint32)) & 1) != 0
+
+    return np.where(
+        (l1_state == I) | ~has,
+        I,
+        np.where(owner == cores, l1_state, np.where(shbit, S, I)),
+    ).astype(l1_state.dtype)

@@ -10,6 +10,7 @@ import pytest
 
 from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
 from primesim_tpu.golden.sim import GoldenSim
+from primesim_tpu.sim.validate import I, effective_l1_state, engine_l1_to_golden
 from primesim_tpu.trace import synth
 
 
@@ -37,9 +38,26 @@ def assert_parity(cfg, trace, chunk_steps=64):
 
     np.testing.assert_array_equal(e.cycles, g.cycles, err_msg="cycles")
     np.testing.assert_array_equal(np.asarray(e.state.ptr), g.ptr, err_msg="ptr")
-    np.testing.assert_array_equal(np.asarray(e.state.l1_tag), g.l1_tag, err_msg="l1_tag")
+    # The engine's L1 arrays hold only locally-written state (pull-based
+    # coherence); the golden's eager MESI state must equal the engine's
+    # directory-VALIDATED state at every way, with matching tags wherever
+    # the golden holds a valid line. This is the empirical proof of the
+    # eager/pull equivalence (DESIGN.md §7).
+    eff = effective_l1_state(
+        cfg,
+        np.asarray(e.state.l1_tag),
+        np.asarray(e.state.l1_state),
+        np.asarray(e.state.llc_tag),
+        np.asarray(e.state.llc_owner),
+        np.asarray(e.state.sharers),
+    )
+    np.testing.assert_array_equal(eff, g.l1_state, err_msg="effective l1_state")
+    valid = g.l1_state != I
+    e_l1_tag = engine_l1_to_golden(cfg, np.asarray(e.state.l1_tag))
     np.testing.assert_array_equal(
-        np.asarray(e.state.l1_state), g.l1_state, err_msg="l1_state"
+        np.where(valid, e_l1_tag, -1),
+        np.where(valid, g.l1_tag, -1),
+        err_msg="l1_tag (valid ways)",
     )
     np.testing.assert_array_equal(np.asarray(e.state.llc_tag), g.llc_tag, err_msg="llc_tag")
     np.testing.assert_array_equal(
@@ -56,7 +74,9 @@ def assert_parity(cfg, trace, chunk_steps=64):
         np.testing.assert_array_equal(ec[k], v, err_msg=f"counter {k}")
     # LRU parity (modulo int width): compare where entries are valid
     np.testing.assert_array_equal(
-        np.asarray(e.state.l1_lru), g.l1_lru, err_msg="l1_lru"
+        engine_l1_to_golden(cfg, np.asarray(e.state.l1_lru)),
+        g.l1_lru,
+        err_msg="l1_lru",
     )
     np.testing.assert_array_equal(
         np.asarray(e.state.llc_lru), g.llc_lru, err_msg="llc_lru"
