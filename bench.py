@@ -1,0 +1,86 @@
+"""Benchmark: 1024-core mesh-MESI simulation speed on one TPU chip.
+
+Runs the flagship BASELINE.json ladder config — 1024 in-order cores,
+32x32-mesh NoC, private L1s + 1024-bank directory-coherent LLC — over a
+SPLASH-2-FFT-shaped synthetic trace (local strided compute phases +
+butterfly exchanges), end to end through the chunked Engine (including
+host-side counter drains and termination checks).
+
+Prints ONE JSON line: simulated MIPS (million simulated target
+instructions per wall second).
+
+`vs_baseline` compares against 20 MIPS — the upper end of the reference
+simulator's published multi-host aggregate throughput (ISPASS'14 paper,
+SURVEY.md §6; BASELINE.json lists no repo-published numbers), i.e. a
+deliberately strong baseline: the whole reference cluster vs one TPU chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_MIPS = 20.0
+
+
+def main() -> None:
+    import numpy as np
+
+    from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+    from primesim_tpu.sim.engine import Engine
+    from primesim_tpu.trace import synth
+
+    C = 1024
+    cfg = MachineConfig(
+        n_cores=C,
+        n_banks=C,
+        l1=CacheConfig(size=32 * 1024, ways=4, line=64, latency=2),
+        llc=CacheConfig(size=256 * 1024, ways=8, line=64, latency=10),
+        noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
+        dram_lat=100,
+        quantum=1000,
+    )
+    from primesim_tpu.trace.format import fold_ins
+
+    trace = fold_ins(
+        synth.fft_like(C, n_phases=4, points_per_core=256, ins_per_mem=8, seed=42)
+    )
+    n_instructions = trace.total_instructions()
+
+    # compile warm-up (one chunk at the same shapes; jit cache persists)
+    from primesim_tpu.sim.engine import run_chunk
+
+    warm = Engine(cfg, trace, chunk_steps=256)
+    warm.state = run_chunk(cfg, 256, warm.events, warm.state)
+    np.asarray(warm.state.cycles)  # block
+
+    eng = Engine(cfg, trace, chunk_steps=256)
+    t0 = time.perf_counter()
+    eng.run(max_steps=10_000_000)
+    wall = time.perf_counter() - t0
+
+    mips = n_instructions / wall / 1e6
+    agg_cycles = int(np.asarray(eng.cycles).max())
+    print(
+        json.dumps(
+            {
+                "metric": "simulated_MIPS_1024core_mesh_mesi",
+                "value": round(mips, 3),
+                "unit": "MIPS",
+                "vs_baseline": round(mips / BASELINE_MIPS, 3),
+                "detail": {
+                    "n_cores": C,
+                    "instructions": int(n_instructions),
+                    "wall_s": round(wall, 2),
+                    "steps": eng.steps_run,
+                    "max_core_cycles": agg_cycles,
+                    "sim_cycles_per_s": round(agg_cycles / wall),
+                    "noc_msgs": int(eng.counters["noc_msgs"].sum()),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -126,11 +126,14 @@ class GoldenSim:
         l1_tag0 = self.l1_tag.copy()
         l1_state0 = self.l1_state.copy()
 
-        requests = []  # (cycles, core, kind, line) with kind in GETS/GETM/UPG
+        # request tuple: (cycles, core, kind, line, pre)
+        requests = []
+        joins = []  # read-join candidates: (core, line, pre)
         GETS, GETM, UPG = 0, 1, 2
 
         for c in active:
             t, arg, addr = int(cur[c][0]), int(cur[c][1]), int(cur[c][2])
+            pre = int(cur[c][3])  # pre-batched non-memory instructions
             if t == EV_INS:
                 self.cycles[c] += arg * int(self.cpi[c])
                 self.counters["instructions"][c] += arg
@@ -145,27 +148,44 @@ class GoldenSim:
                     break
             if t == EV_LD:
                 if w >= 0:  # read hit
-                    self.cycles[c] += cfg.l1.latency
+                    self.cycles[c] += pre * int(self.cpi[c]) + cfg.l1.latency
                     self.counters["l1_read_hits"][c] += 1
-                    self.counters["instructions"][c] += 1
+                    self.counters["instructions"][c] += pre + 1
                     self.l1_lru[c, s, w] = step  # phase A local
                     self.ptr[c] += 1
+                elif self._join_eligible(c, line):
+                    joins.append((c, line, pre))
                 else:
-                    requests.append((int(self.cycles[c]), c, GETS, line))
+                    requests.append((int(self.cycles[c]), c, GETS, line, pre))
             else:  # EV_ST
                 if w >= 0 and l1_state0[c, s, w] in (E, M):  # write hit
-                    self.cycles[c] += cfg.l1.latency
+                    self.cycles[c] += pre * int(self.cpi[c]) + cfg.l1.latency
                     self.counters["l1_write_hits"][c] += 1
-                    self.counters["instructions"][c] += 1
+                    self.counters["instructions"][c] += pre + 1
                     self.l1_state[c, s, w] = M  # silent E->M, phase A local
                     self.l1_lru[c, s, w] = step
                     self.ptr[c] += 1
                 elif w >= 0:  # held in S -> upgrade
-                    requests.append((int(self.cycles[c]), c, UPG, line))
+                    requests.append((int(self.cycles[c]), c, UPG, line, pre))
                 else:
-                    requests.append((int(self.cycles[c]), c, GETM, line))
+                    requests.append((int(self.cycles[c]), c, GETM, line, pre))
 
         # --- phase 2: per-(bank,set) conflict serialization ----------------
+        # Read-joins (GETS to a shared, ownerless, already-shared line)
+        # coalesce: any number retire in one step, bit-exact to any
+        # serialization order because the join path's latency is independent
+        # of the sharer set and the sharer-bit updates commute (DESIGN.md
+        # §3). A join only proceeds if no arbitrating request targets its
+        # home (bank,set) this step; otherwise it demotes to a normal GETS.
+        arb_slots = {
+            (self._bank(r[3]), self._bank_set(r[3])) for r in requests
+        }
+        for c, line, pre in joins:
+            if (self._bank(line), self._bank_set(line)) in arb_slots:
+                requests.append((int(self.cycles[c]), c, GETS, line, pre))
+            else:
+                self._do_join(c, line, pre, step)
+
         by_bankset: dict[tuple[int, int], list] = {}
         for r in requests:
             key = (self._bank(r[3]), self._bank_set(r[3]))
@@ -181,7 +201,7 @@ class GoldenSim:
         # Phase-B op = (core, line, op) with op in {"downgrade","invalidate"}
         phase_b: list[tuple[int, int, str]] = []
 
-        for cyc, c, kind, line in sorted(winners, key=lambda r: r[1]):
+        for cyc, c, kind, line, pre in sorted(winners, key=lambda r: r[1]):
             b = self._bank(line)
             bs = self._bank_set(line)
             ctile = core_tile(c, cfg)
@@ -327,8 +347,8 @@ class GoldenSim:
                 self.l1_state[c, s, vw] = grant
                 self.l1_lru[c, s, vw] = step
 
-            self.cycles[c] += lat
-            self.counters["instructions"][c] += 1
+            self.cycles[c] += pre * int(self.cpi[c]) + lat
+            self.counters["instructions"][c] += pre + 1
             self.ptr[c] += 1
 
         # --- phase 4.B: remote ops, tag-conditional against live state -----
@@ -342,6 +362,59 @@ class GoldenSim:
                     else:
                         self.l1_state[tcore, s, wy] = I
                     break
+
+    # ------------------------------------------------------ read-join path
+
+    def _join_eligible(self, c: int, line: int) -> bool:
+        """GETS may coalesce iff the line is LLC-resident, ownerless, and
+        already shared by someone else (DESIGN.md §3 'plain join' case —
+        the only transition whose outcome and latency are independent of
+        concurrent same-line readers)."""
+        b, bs = self._bank(line), self._bank_set(line)
+        for wy in range(self.cfg.llc.ways):
+            if self.llc_tag[b, bs, wy] == line:
+                if self.llc_owner[b, bs, wy] >= 0:
+                    return False
+                shl = self._sharers_from(self.sharers, b, bs, wy)
+                return any(t != c for t in shl)
+        return False
+
+    def _do_join(self, c: int, line: int, pre: int, step: int) -> None:
+        """Retire one coalesced read-join (same outcome as the serialized
+        'sharers non-empty -> S, sharers |= {c}' path)."""
+        cfg = self.cfg
+        b, bs = self._bank(line), self._bank_set(line)
+        ctile, btile = core_tile(c, cfg), bank_tile(b, cfg)
+        w = -1
+        for wy in range(cfg.llc.ways):
+            if self.llc_tag[b, bs, wy] == line:
+                w = wy
+                break
+        self.counters["l1_read_misses"][c] += 1
+        self.counters["llc_hits"][c] += 1
+        lat = cfg.l1.latency
+        lat += self._noc(c, ctile, btile)
+        lat += cfg.llc.latency
+        self._set_sharer(b, bs, w, c, True)
+        self.llc_lru[b, bs, w] = step
+        lat += self._noc(c, btile, ctile)
+        ov = cfg.core.o3_overlap_256
+        if ov:
+            lat = lat - ((lat * ov) >> 8)
+        # L1 fill (victim on step-start state == live state for this set:
+        # joins are this core's only action this step)
+        s = self._l1_set(line)
+        vw = self._victim_way(
+            self.l1_tag[c, s], self.l1_state[c, s], self.l1_lru[c, s]
+        )
+        if self.l1_state[c, s, vw] == M:
+            self.counters["l1_writebacks"][c] += 1
+        self.l1_tag[c, s, vw] = line
+        self.l1_state[c, s, vw] = S
+        self.l1_lru[c, s, vw] = step
+        self.cycles[c] += pre * int(self.cpi[c]) + lat
+        self.counters["instructions"][c] += pre + 1
+        self.ptr[c] += 1
 
     # ----------------------------------------------------- static helpers
 

@@ -63,7 +63,7 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # ---- phase 0: gather events, quantum barrier -------------------------
     p = jnp.minimum(st.ptr, T - 1)
     ev = events[arange_c, p]  # [C, 3]
-    et, earg, eaddr = ev[:, 0], ev[:, 1], ev[:, 2]
+    et, earg, eaddr, epre = ev[:, 0], ev[:, 1], ev[:, 2], ev[:, 3]
     not_done = et != EV_END
     any_not_done = jnp.any(not_done)
     any_active = jnp.any(not_done & (st.cycles < st.quantum_end))
@@ -136,34 +136,18 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     upg = is_mem & is_st_ev & hit_any & (hit_state == S)
     gets = is_mem & ~is_st_ev & ~hit_any
     getm = is_mem & is_st_ev & ~hit_any
-    req = gets | getm | upg
 
-    # ---- phase 2: per-(bank,set) winner arbitration ----------------------
+    # LLC lookup for the accessed line (step-start, all lanes — needed both
+    # for join eligibility below and the winner transitions in phase 3)
     bank = line & (B - 1)
     bset = (line >> (B.bit_length() - 1)) & (S2 - 1)
     slot = bank * S2 + bset  # [C], exact (bank,set) id
-    rel = st.cycles - (quantum_end - Q)  # in [0, Q) for active requesters
-    key = rel * C + arange_c  # orders by (cycles, core_id); < Q*C < 2^31
-    table = jnp.full(B * S2, INT32_MAX, jnp.int32)
-    table = table.at[jnp.where(req, slot, B * S2)].min(key, mode="drop")
-    winner = req & (table[slot] == key)
-    retry = req & ~winner
-    cnt = cadd(cnt, "retries", retry)
-
-    # ---- phase 3: directory transition on step-start state ---------------
-    ctile = arange_c % n_tiles
-    btile = bank % n_tiles
-    req_lat, req_hops = _one_way(ctile, btile, cfg)
-    rep_lat, rep_hops = _one_way(btile, ctile, cfg)
-
     llc_tag_rows = st.llc_tag[bank, bset]  # [C, W2]
     llc_match = llc_tag_rows == line[:, None]
-    llc_hit = jnp.any(llc_match, axis=1) & winner
+    llc_has = jnp.any(llc_match, axis=1)
     llc_hway = jnp.argmax(llc_match, axis=1).astype(jnp.int32)
-    llc_miss = winner & ~jnp.any(llc_match, axis=1)
-
     owner = st.llc_owner[bank, bset, llc_hway]  # [C]
-    # one contiguous row gather serves both the hit way and the victim way
+    # one contiguous row gather serves hit way, victim way, and join path
     sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]
     shw = jnp.take_along_axis(sh_rows, llc_hway[:, None, None], axis=1)[:, 0]
 
@@ -178,6 +162,38 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
 
     sh_bits = unpack_bits(shw)
     sh_bits = sh_bits & (arange_c[None, :] != arange_c[:, None])  # exclude self
+    other_sharers = jnp.any(sh_bits, axis=1)
+
+    # ---- phase 2: read-join coalescing + per-(bank,set) arbitration ------
+    # GETS to an LLC-resident, ownerless, already-shared line may coalesce:
+    # the serialized 'plain join' transition (S grant, sharers |= {c}) has
+    # latency independent of the sharer set and commutative state updates,
+    # so any number retire in one step, bit-exact to any serialization
+    # order (DESIGN.md §3). A join only proceeds if no arbitrating request
+    # targets its home (bank,set) this step; else it demotes to normal GETS.
+    join_elig = gets & llc_has & (owner == -1) & other_sharers
+    req = (gets & ~join_elig) | getm | upg
+    rel = st.cycles - (quantum_end - Q)  # in [0, Q) for active requesters
+    key = rel * C + arange_c  # orders by (cycles, core_id); < Q*C < 2^31
+    table = jnp.full(B * S2, INT32_MAX, jnp.int32)
+    table = table.at[jnp.where(req, slot, B * S2)].min(key, mode="drop")
+    slot_busy = table[slot] != INT32_MAX
+    join = join_elig & ~slot_busy
+    demoted = join_elig & slot_busy
+    table = table.at[jnp.where(demoted, slot, B * S2)].min(key, mode="drop")
+    req = req | demoted
+    winner = req & (table[slot] == key)
+    retry = req & ~winner
+    cnt = cadd(cnt, "retries", retry)
+
+    # ---- phase 3: directory transition on step-start state ---------------
+    ctile = arange_c % n_tiles
+    btile = bank % n_tiles
+    req_lat, req_hops = _one_way(ctile, btile, cfg)
+    rep_lat, rep_hops = _one_way(btile, ctile, cfg)
+
+    llc_hit = llc_has & winner
+    llc_miss = winner & ~llc_has
 
     # per-pair round-trip latency/hops from home bank to target core
     ttile = arange_c % n_tiles  # target tiles
@@ -238,32 +254,41 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     if ov:
         lat = lat - ((lat * ov) >> 8)
 
-    # --- granted L1 state
+    # join path latency: plain uncore round trip, no probe/inv/DRAM extras
+    lat_join = cfg.l1.latency + req_lat + cfg.llc.latency + rep_lat
+    if ov:
+        lat_join = lat_join - ((lat_join * ov) >> 8)
+
+    # --- granted L1 state (joins always take S)
     grant = jnp.where(
-        write_w,
-        M,
-        jnp.where(gets_probe | gets_shared, S, E),  # GETS: E on excl/miss
+        join,
+        S,
+        jnp.where(
+            write_w,
+            M,
+            jnp.where(gets_probe | gets_shared, S, E),  # GETS: E on excl/miss
+        ),
     )
 
-    # ---- counters for winners -------------------------------------------
-    cnt = cadd(cnt, "l1_read_misses", gets_w)
+    # ---- counters for winners + joins -----------------------------------
+    cnt = cadd(cnt, "l1_read_misses", gets_w | join)
     cnt = cadd(cnt, "l1_write_misses", getm & winner)
     cnt = cadd(cnt, "upgrades", upg & winner)
-    cnt = cadd(cnt, "llc_hits", llc_hit)
+    cnt = cadd(cnt, "llc_hits", llc_hit | join)
     cnt = cadd(cnt, "llc_misses", llc_miss)
     cnt = cadd(cnt, "dram_accesses", llc_miss)
     cnt = cadd(cnt, "llc_writebacks", llc_miss & vic_valid & (vic_owner >= 0))
     cnt = cadd(cnt, "probes", probe_any)
     cnt = cadd(cnt, "invalidations", jnp.where(write_w & llc_hit, inv_count, 0) + back_count)
     noc_msgs = (
-        jnp.where(winner, 2, 0)  # request + reply
+        jnp.where(winner | join, 2, 0)  # request + reply
         + jnp.where(probe_any, 2, 0)
         + jnp.where(write_w & llc_hit, 2 * inv_count, 0)
         + jnp.where(llc_miss, 2, 0)  # DRAM (co-located controller)
         + 2 * back_count
     )
     noc_hops = (
-        jnp.where(winner, req_hops + rep_hops, 0)
+        jnp.where(winner | join, req_hops + rep_hops, 0)
         + jnp.where(probe_any, 2 * po_hops, 0)
         + jnp.where(write_w & llc_hit, inv_hops, 0)
         + back_hops
@@ -272,21 +297,27 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     cnt = cadd(cnt, "noc_hops", noc_hops)
 
     # ---- phase 4.A: local updates ----------------------------------------
-    # retire + clock advance
+    # retire + clock advance (memory events also charge their pre-batched
+    # non-memory instructions: epre * cpi, PriME per-BBL batching)
     hit = read_hit | write_hit
     cnt = cadd(cnt, "l1_read_hits", read_hit)
     cnt = cadd(cnt, "l1_write_hits", write_hit)
-    retired = is_ins | hit | winner
+    retired = is_ins | hit | winner | join
+    cpi_vec = jnp.asarray(cfg.core.cpi_vector(C), jnp.int32)
+    mem_ret = hit | winner | join
+    mem_lat = jnp.where(
+        hit, cfg.l1.latency, jnp.where(join, lat_join, lat)
+    )
     cycles = st.cycles + jnp.where(
         is_ins,
-        earg * jnp.asarray(cfg.core.cpi_vector(C), jnp.int32),
-        jnp.where(hit, cfg.l1.latency, jnp.where(winner, lat, 0)),
+        earg * cpi_vec,
+        jnp.where(mem_ret, epre * cpi_vec + mem_lat, 0),
     )
     ptr = st.ptr + retired.astype(jnp.int32)
     cnt = cadd(
         cnt,
         "instructions",
-        jnp.where(is_ins, earg, 0) + (hit | winner).astype(jnp.int32),
+        jnp.where(is_ins, earg, 0) + jnp.where(mem_ret, epre + 1, 0),
     )
 
     # L1-side updates are branchless one-hot selects (row index = own core);
@@ -307,14 +338,14 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # directory-invalidated (stale) ways as free, matching eager-MESI's
     # invalid-first rule; the victim writeback fires only on EFFECTIVE M.
     upg_in_place = upg & winner  # upg requires an L1 hit: always in-place
-    fill = winner & ~upg_in_place
+    fill = (winner & ~upg_in_place) | join
     lru_rows = jnp.take_along_axis(st.l1_lru, w1cols, axis=1)  # [C, W1]
     l1_vkey = jnp.where(weff == I, -1, lru_rows)
     l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
     cnt = cadd(cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M))
     upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
     updway_sel = set_sel & ((colr // S1) == upd_way[:, None])
-    sel_w = winner[:, None] & updway_sel
+    sel_w = (winner | join)[:, None] & updway_sel
     # a fill may duplicate a stale way's tag: clear the stale copy so tags
     # stay unique per set (else the refill could "resurrect" it, since the
     # directory once again records this core for the line)
@@ -367,6 +398,24 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     )
     wslot_upd = jnp.where(winner, slot, B * S2)
     sharers_n = st.sharers.at[wslot_upd].set(new_row, mode="drop")
+
+    # join LLC updates: sharer bits accumulate by scatter-ADD (each joiner
+    # contributes a distinct bit, and join slots never have a winner, so
+    # the adds are collision-free w.r.t. the winner row writes above);
+    # LRU refresh via scatter-max (idempotent across same-slot joiners)
+    join_seg = (
+        jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_hway[:, None]
+    )
+    join_row = jnp.where(
+        join_seg & join[:, None],
+        jnp.broadcast_to(self_word[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
+        jnp.uint32(0),
+    )
+    jslot = jnp.where(join, slot, B * S2)
+    sharers_n = sharers_n.at[jslot].add(join_row, mode="drop")
+    llc_lru_n = llc_lru_n.at[
+        jnp.where(join, bank, B), bset, llc_hway
+    ].max(step_no, mode="drop")
 
     # No phase 4.B: under pull-based coherence, the directory updates above
     # ARE the invalidations/downgrades — remote L1s re-derive their state on

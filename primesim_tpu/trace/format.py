@@ -2,17 +2,26 @@
 
 TPU-native replacement for the reference's Pin-frontend event stream
 (SURVEY.md §2 #1, §3.2/3.3: per-BBL instruction-count batching + per-access
-`execMem(addr, size, R/W)` analysis calls). Events are fixed 3x int32 records
-so host->device ingest is a single contiguous copy and the C++ frontend
-(`primesim_tpu/frontend/`) can write the same format with one fwrite.
+`execMem(addr, size, R/W)` analysis calls). Events are fixed 4x int32
+records so host->device ingest is a single contiguous copy and the C++
+frontend (`primesim_tpu/frontend/`) can write the same format with one
+fwrite.
+
+The fourth field, `pre`, carries the count of non-memory instructions
+retired immediately before a memory event — the PriME-style per-basic-block
+batching (SURVEY.md §3.2) folded to memory-access boundaries. A trace using
+explicit INS events (pre = 0 everywhere) and its `fold_ins()` image are the
+same workload; folding retires each INS batch together with the following
+access in ONE simulation step, which matters because steps, not events, are
+the engine's unit of wall-clock cost.
 
 Binary file layout (little-endian):
     magic   uint32  0x50545055  ("PTPU")
-    version uint32  1
+    version uint32  2   (v1 files with 3-field records load fine, pre=0)
     n_cores uint32
     max_len uint32  (padded per-core event count)
     lengths uint32[n_cores]  (true event count per core, <= max_len)
-    events  int32[n_cores, max_len, 3]   (type, arg, addr)
+    events  int32[n_cores, max_len, 4]   (type, arg, addr, pre)
 
 Cores with fewer than max_len events are padded with END events.
 """
@@ -22,7 +31,7 @@ from __future__ import annotations
 import numpy as np
 
 MAGIC = 0x50545055
-VERSION = 1
+VERSION = 2
 
 # Event types (DESIGN.md §2)
 EV_INS = 0  # batch of non-memory instructions; arg = count
@@ -30,7 +39,7 @@ EV_LD = 1  # load;  addr = byte address (31-bit in v1), arg = size
 EV_ST = 2  # store; addr = byte address (31-bit in v1), arg = size
 EV_END = 3  # core finished
 
-N_FIELDS = 3  # (type, arg, addr)
+N_FIELDS = 4  # (type, arg, addr, pre)
 
 
 class Trace:
@@ -50,6 +59,8 @@ class Trace:
                 raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
             if (events[:, :, 1][t == EV_INS] < 0).any():
                 raise ValueError("INS batch counts must be >= 0")
+            if (events[:, :, 3][mem] < 0).any():
+                raise ValueError("pre-batched instruction counts must be >= 0")
             if (lengths > events.shape[1]).any() or (lengths < 1).any():
                 raise ValueError("per-core lengths out of range")
             # every core's row must terminate: the event at lengths-1 is END
@@ -69,11 +80,12 @@ class Trace:
         return self.events.shape[1]
 
     def total_instructions(self) -> int:
-        """Total simulated instructions (INS batch counts + 1 per mem op)."""
+        """Total simulated instructions (INS + pre-batched + 1 per mem op)."""
         t = self.events[:, :, 0]
         ins = np.where(t == EV_INS, self.events[:, :, 1], 0).astype(np.int64).sum()
-        mem = int(((t == EV_LD) | (t == EV_ST)).sum())
-        return int(ins) + mem
+        mem_mask = (t == EV_LD) | (t == EV_ST)
+        pre = np.where(mem_mask, self.events[:, :, 3], 0).astype(np.int64).sum()
+        return int(ins) + int(pre) + int(mem_mask.sum())
 
     # ---------------------------------------------------------------- I/O
 
@@ -90,38 +102,75 @@ class Trace:
             hdr = np.fromfile(f, dtype="<u4", count=4)
             if hdr.shape[0] != 4 or hdr[0] != MAGIC:
                 raise ValueError(f"{path}: not a primesim_tpu trace file")
-            if hdr[1] != VERSION:
+            if hdr[1] not in (1, 2):
                 raise ValueError(f"{path}: unsupported trace version {hdr[1]}")
+            nf = 3 if hdr[1] == 1 else N_FIELDS
             n_cores, max_len = int(hdr[2]), int(hdr[3])
             lengths = np.fromfile(f, dtype="<u4", count=n_cores).astype(np.int32)
-            events = np.fromfile(f, dtype="<i4", count=n_cores * max_len * N_FIELDS)
-            if events.size != n_cores * max_len * N_FIELDS:
+            events = np.fromfile(f, dtype="<i4", count=n_cores * max_len * nf)
+            if events.size != n_cores * max_len * nf:
                 raise ValueError(f"{path}: truncated trace file")
-            events = events.reshape(n_cores, max_len, N_FIELDS).astype(np.int32)
+            events = events.reshape(n_cores, max_len, nf).astype(np.int32)
+            if nf == 3:  # v1: no pre field
+                events = np.concatenate(
+                    [events, np.zeros((n_cores, max_len, 1), np.int32)], axis=2
+                )
         return Trace(events, lengths)
 
 
-def from_event_lists(per_core: list[list[tuple[int, int, int]]]) -> Trace:
+def from_event_lists(per_core: list[list[tuple]]) -> Trace:
     """Build a padded Trace from python per-core event lists.
 
-    Each event is (type, arg, addr). An END event is appended to every core.
+    Each event is (type, arg, addr) or (type, arg, addr, pre); pre defaults
+    to 0. An END event is appended to every core.
     """
     n_cores = len(per_core)
     lengths = np.array([len(evs) + 1 for evs in per_core], dtype=np.int32)
     max_len = int(lengths.max()) if n_cores else 1
-    events = np.empty((n_cores, max_len, N_FIELDS), dtype=np.int32)
+    events = np.zeros((n_cores, max_len, N_FIELDS), dtype=np.int32)
     events[:, :, 0] = EV_END
-    events[:, :, 1] = 0
-    events[:, :, 2] = 0
     for c, evs in enumerate(per_core):
         if evs:
-            arr = np.asarray(evs, dtype=np.int64)
-            # addresses may be given as uint32-range python ints; view as int32
+            arr = np.asarray(
+                [tuple(e) + (0,) * (N_FIELDS - len(e)) for e in evs],
+                dtype=np.int64,
+            )
             e = np.empty((len(evs), N_FIELDS), dtype=np.int32)
             e[:, 0] = arr[:, 0].astype(np.int32)
             e[:, 1] = arr[:, 1].astype(np.int32)
             if (arr[:, 2] < 0).any() or (arr[:, 2] >= 2**31).any():
                 raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
             e[:, 2] = arr[:, 2].astype(np.int32)
+            e[:, 3] = arr[:, 3].astype(np.int32)
             events[c, : len(evs)] = e
     return Trace(events, lengths)
+
+
+def fold_ins(trace: Trace) -> Trace:
+    """Fold INS batches into the following memory event's `pre` field.
+
+    The folded trace is the same workload expressed in PriME's per-BBL
+    batched form (SURVEY.md §3.2): each batch of non-memory instructions
+    retires in the same simulation step as the memory access that follows
+    it. INS batches not followed by a memory event (trailing work before
+    END) are kept as explicit INS events.
+    """
+    out: list[list[tuple]] = []
+    for c in range(trace.n_cores):
+        evs: list[tuple] = []
+        acc = 0
+        for i in range(int(trace.lengths[c])):
+            t, arg, addr, pre = (int(x) for x in trace.events[c, i])
+            if t == EV_INS:
+                acc += arg
+            elif t in (EV_LD, EV_ST):
+                evs.append((t, arg, addr, pre + acc))
+                acc = 0
+            else:  # END
+                if acc:
+                    evs.append((EV_INS, acc, 0))
+                    acc = 0
+        if acc:
+            evs.append((EV_INS, acc, 0))
+        out.append(evs)
+    return from_event_lists(out)

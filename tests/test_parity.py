@@ -142,3 +142,25 @@ def test_parity_non_pow2_cores():
     # only banks/sets/line need pow2 mask arithmetic
     cfg = machine(12, n_banks=4)
     assert_parity(cfg, GENS["false_sharing"](12))
+
+
+def test_parity_folded_traces():
+    # fold_ins moves INS batches into mem events' pre field (pre > 0 paths);
+    # golden and engine must stay bit-exact on the folded representation
+    from primesim_tpu.trace.format import fold_ins
+
+    for name in ("uniform_random", "false_sharing", "fft_like"):
+        cfg = machine(8)
+        assert_parity(cfg, fold_ins(GENS[name](8)))
+
+
+def test_fold_ins_preserves_instructions():
+    from primesim_tpu.trace.format import EV_INS, fold_ins
+
+    tr = GENS["fft_like"](8)
+    folded = fold_ins(tr)
+    assert folded.total_instructions() == tr.total_instructions()
+    # folded traces should have (almost) no standalone INS events left
+    t = folded.events[:, :, 0]
+    assert (t == EV_INS).sum() <= folded.n_cores  # at most one trailing per core
+    assert folded.max_len < tr.max_len
