@@ -62,7 +62,7 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
 
     # ---- phase 0: gather events, quantum barrier -------------------------
     p = jnp.minimum(st.ptr, T - 1)
-    ev = events[arange_c, p]  # [C, 3]
+    ev = events[arange_c, p]  # [C, 4]
     et, earg, eaddr, epre = ev[:, 0], ev[:, 1], ev[:, 2], ev[:, 3]
     not_done = et != EV_END
     any_not_done = jnp.any(not_done)
@@ -402,13 +402,18 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # join LLC updates: sharer bits accumulate by scatter-ADD (each joiner
     # contributes a distinct bit, and join slots never have a winner, so
     # the adds are collision-free w.r.t. the winner row writes above);
-    # LRU refresh via scatter-max (idempotent across same-slot joiners)
+    # LRU refresh via scatter-max (idempotent across same-slot joiners).
+    # Mask out bits already set in the step-start word (self_word & ~shw):
+    # a silently-evicted sharer that re-joins still has its stale bit
+    # recorded, and an unmasked add would carry into the adjacent bit —
+    # golden's _set_sharer is idempotent, so the masked add matches it.
     join_seg = (
         jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_hway[:, None]
     )
+    join_word = self_word & ~shw  # carry-free when the bit is already set
     join_row = jnp.where(
         join_seg & join[:, None],
-        jnp.broadcast_to(self_word[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
+        jnp.broadcast_to(join_word[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
         jnp.uint32(0),
     )
     jslot = jnp.where(join, slot, B * S2)

@@ -43,7 +43,8 @@ N_FIELDS = 4  # (type, arg, addr, pre)
 
 
 class Trace:
-    """Per-core event arrays: events[n_cores, max_len, 3] int32."""
+    """Per-core event arrays: events[n_cores, max_len, 4] int32 records
+    (type, arg, addr, pre)."""
 
     def __init__(self, events: np.ndarray, lengths: np.ndarray):
         events = np.asarray(events, dtype=np.int32)

@@ -154,6 +154,35 @@ def test_parity_folded_traces():
         assert_parity(cfg, fold_ins(GENS[name](8)))
 
 
+def test_parity_rejoin_after_silent_eviction():
+    # Regression (ADVICE r1, high): a sharer whose L1 copy was silently
+    # evicted still has its directory bit set; when it re-reads the line as
+    # a coalesced join, the engine's sharer scatter-ADD must not carry into
+    # the adjacent bit (golden's _set_sharer is idempotent).
+    from primesim_tpu.trace.format import EV_INS, EV_LD, from_event_lists
+
+    cfg = machine(4)  # l1: 8 sets x 2 ways; lines 0, 8, 16 share L1 set 0
+    trace = from_event_lists(
+        [
+            [
+                (EV_INS, 100, 0),  # let core 1 take ownership first
+                (EV_LD, 4, 0),     # probe owner -> sharers {0,1}, owner -1
+                (EV_LD, 4, 8 * 64),   # conflicting fill (L1 set 0)
+                (EV_LD, 4, 16 * 64),  # second fill silently evicts line 0
+                (EV_LD, 4, 0),     # re-read: join with stale self-bit set
+            ],
+            [(EV_LD, 4, 0)],  # first reader, then idle
+            [],
+            [],
+        ]
+    )
+    assert_parity(cfg, trace)
+    # and the sharer set for line 0 must be exactly {0, 1}
+    g = GoldenSim(cfg, trace)
+    g.run()
+    assert g._sharers_from(g.sharers, 0, 0, 0) == [0, 1]
+
+
 def test_fold_ins_preserves_instructions():
     from primesim_tpu.trace.format import EV_INS, fold_ins
 
