@@ -105,6 +105,13 @@ class MachineConfig:
     noc: NocConfig = field(default_factory=NocConfig)
     dram_lat: int = 100
     quantum: int = 1000  # relaxed-sync quantum, cycles (the fidelity/speed knob)
+    # Local-run length: how many LOCAL events (INS batches, L1 hits) each
+    # core may retire per step BEFORE the one arbitrated uncore event
+    # (DESIGN.md §3 "local runs"). 0 = one event per core per step. This is
+    # the analogue of the reference frontend never crossing a process
+    # boundary for non-miss work (SURVEY.md §3.2): private hits shouldn't
+    # cost a simulation step.
+    local_run_len: int = 0
 
     def __post_init__(self):
         self.validate()
@@ -127,6 +134,8 @@ class MachineConfig:
             raise ValueError("NoC latencies must be >= 0")
         if self.noc.mesh_x < 1 or self.noc.mesh_y < 1:
             raise ValueError("mesh dims must be >= 1")
+        if not (0 <= self.local_run_len <= 64):
+            raise ValueError("local_run_len must be in [0, 64]")
 
     # Derived geometry used by both engines --------------------------------
 

@@ -116,6 +116,63 @@ class GoldenSim:
         step = self.step_count
         self.step_count += 1
 
+        # --- phase 0.5: local runs (DESIGN.md §3) --------------------------
+        # Each active core first retires up to `local_run_len` LOCAL events
+        # (INS batches, L1 read hits, L1 write hits in E/M) in order, judged
+        # against the live directory (which no run modifies — runs touch only
+        # the core's own L1 row: LRU refresh, silent E->M) and the core's own
+        # live L1 state. The run stops at the first non-local event, at the
+        # quantum boundary, or after local_run_len events. The event then at
+        # ptr enters the normal per-step phases below.
+        for c in active:
+            for _ in range(cfg.local_run_len):
+                if self.cycles[c] >= self.quantum_end:
+                    break
+                e = ev[c, min(int(self.ptr[c]), self.trace.max_len - 1)]
+                t, arg, addr = int(e[0]), int(e[1]), int(e[2])
+                pre = int(e[3])
+                if t == EV_END:
+                    break
+                if t == EV_INS:
+                    self.cycles[c] += arg * int(self.cpi[c])
+                    self.counters["instructions"][c] += arg
+                    self.ptr[c] += 1
+                    continue
+                line = self._line(addr)
+                s = self._l1_set(line)
+                w = -1
+                for wy in range(cfg.l1.ways):
+                    if (
+                        self.l1_tag[c, s, wy] == line
+                        and self.l1_state[c, s, wy] != I
+                    ):
+                        w = wy
+                        break
+                if w < 0:
+                    break  # miss: stop the run, arbitrate below
+                if t == EV_ST and self.l1_state[c, s, w] not in (E, M):
+                    break  # held in S: upgrade request, arbitrate below
+                self.cycles[c] += pre * int(self.cpi[c]) + cfg.l1.latency
+                self.counters["instructions"][c] += pre + 1
+                if t == EV_LD:
+                    self.counters["l1_read_hits"][c] += 1
+                else:
+                    self.counters["l1_write_hits"][c] += 1
+                    self.l1_state[c, s, w] = M  # silent E->M
+                self.l1_lru[c, s, w] = step
+                self.ptr[c] += 1
+        if cfg.local_run_len:
+            # re-gather events and the active set at the post-run pointers
+            cur = [
+                ev[c, min(int(self.ptr[c]), self.trace.max_len - 1)]
+                for c in range(C)
+            ]
+            active = [
+                c
+                for c in range(C)
+                if cur[c][0] != EV_END and self.cycles[c] < self.quantum_end
+            ]
+
         # --- phase 0/1: classify against step-start state ------------------
         # Only the L1 tag/state arrays need step-start snapshots: phase-3
         # reads of OTHER cores' L1 rows (owner probes) must not see this

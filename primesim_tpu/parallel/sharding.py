@@ -58,6 +58,7 @@ def state_pspecs() -> MachineState:
         l1_tag=P(AXIS),
         l1_state=P(AXIS),
         l1_lru=P(AXIS),
+        l1_ptr=P(AXIS),
         llc_tag=P(AXIS),
         llc_owner=P(AXIS),
         llc_lru=P(AXIS),

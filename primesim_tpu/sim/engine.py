@@ -1,6 +1,7 @@
 """Vectorized JAX simulation engine — the TPU re-host of PriME's backend.
 
-One `step()` advances every target core by at most one trace event,
+One `step()` advances every target core by up to `local_run_len` local
+events (INS batches, L1 hits) plus at most one arbitrated uncore event,
 implementing DESIGN.md's canonical per-step semantics branchlessly:
 
 - CoreManager's per-core cycle tick (SURVEY.md §2 #2) is a masked lane
@@ -12,9 +13,17 @@ implementing DESIGN.md's canonical per-step semantics branchlessly:
   scatter-min arbitration: one winner per LLC (bank,set) per step.
 - The relaxed quantum barrier (#10) is the active-mask + quantum_end bump;
   the outer `lax.scan` step IS the quantum-bounded global clock [DRIVER].
+- Local runs (#1/#3.2: PriME's non-memory path never crosses a process
+  boundary) retire private-hit runs without paying a full step.
 
 The engine must match `primesim_tpu.golden.sim.GoldenSim` BIT-EXACTLY —
 tests/test_parity.py enforces this on every workload generator.
+
+The host driver (`Engine`) dispatches ONE fused device program per run —
+`lax.while_loop` over scan chunks with on-device counter draining, clock
+rebasing, and termination tests — because each host->device dispatch costs
+tens of ms through remote-TPU tunnels; SURVEY.md §7 "host->TPU ingest
+bandwidth ... is the wall-clock make-or-break".
 """
 
 from __future__ import annotations
@@ -31,6 +40,7 @@ from ..trace.format import EV_END, EV_INS, EV_LD, EV_ST, Trace
 from .state import E, I, M, MachineState, S, init_state
 
 INT32_MAX = np.int32(2**31 - 1)
+_ACC_BITS = 30  # device counter accumulators carry into hi above 2^30
 
 _CIDX = {k: i for i, k in enumerate(COUNTER_NAMES)}
 
@@ -44,6 +54,57 @@ def _one_way(tile_a, tile_b, cfg: MachineConfig):
     return h * cfg.noc.link_lat + (h + 1) * cfg.noc.router_lat, h
 
 
+def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
+              llc_tag, llc_owner, sharers, line):
+    """Gather the accessed L1 set and derive each way's EFFECTIVE MESI state.
+
+    PULL-BASED COHERENCE (the TPU-native shape of MESI): remote
+    invalidations and downgrades are never pushed into target L1 arrays —
+    that costs O(C * S1 * W1) table gathers per step. Instead each L1 way
+    stores only locally-written state, and its effective state is derived
+    on access by validating against the directory (which phase 4 maintains
+    exactly):
+        no local entry, or line absent from LLC          -> I
+        directory owner == this core                     -> local state
+        this core recorded in the sharer bit-vector      -> S  (covers
+                                             probe-downgraded old owners)
+        otherwise                                        -> I  (stale)
+    Observably equivalent to eager invalidation (DESIGN.md §7); the eager
+    golden model + parity tests prove it on every workload.
+
+    The directory entry is located through the way pointer (`l1_ptr`,
+    recorded at fill time) — three 1-element gathers — instead of a
+    W2-wide tag search of the home set; a stale pointer self-detects by
+    tag mismatch and yields exactly the search result (DESIGN.md §7).
+
+    Returns (w1cols, tag_rows, weff): the set's column indices, tags, and
+    effective per-way MESI states, all [C, W1].
+    """
+    S1, W1 = cfg.l1.sets, cfg.l1.ways
+    NW = cfg.n_sharer_words
+    l1s = line & (S1 - 1)
+    # L1 arrays are [C, W1*S1] (column w*S1 + s); pull the accessed set's
+    # per-way columns
+    w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]
+    tag_rows = jnp.take_along_axis(l1_tag, w1cols, axis=1)  # [C, W1]
+    state_rows = jnp.take_along_axis(l1_state, w1cols, axis=1)
+    ptr_rows = jnp.take_along_axis(l1_ptr, w1cols, axis=1)  # [C, W1]
+    vtag = llc_tag.reshape(-1)[ptr_rows]  # [C, W1]
+    vown = llc_owner.reshape(-1)[ptr_rows]
+    vsh = sharers.reshape(-1)[ptr_rows * NW + (arange_c[:, None] >> 5)]
+    vbit = ((vsh >> (arange_c[:, None] & 31).astype(jnp.uint32)) & 1) != 0
+    weff = jnp.where(
+        (state_rows == I) | (vtag != tag_rows),
+        I,
+        jnp.where(
+            vown == arange_c[:, None],
+            state_rows,
+            jnp.where(vbit, S, I),
+        ),
+    )  # [C, W1] effective MESI per way
+    return w1cols, tag_rows, weff
+
+
 def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineState:
     C = cfg.n_cores
     B = cfg.n_banks
@@ -54,77 +115,93 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     T = events.shape[1]
     n_tiles = cfg.n_tiles
     arange_c = jnp.arange(C, dtype=jnp.int32)
+    cpi_vec = jnp.asarray(cfg.core.cpi_vector(C), jnp.int32)
+    colr = jnp.arange(W1 * S1, dtype=jnp.int32)[None, :]  # [1, W1*S1]
 
     cnt = st.counters
 
     def cadd(cnt, name, amount):
         return cnt.at[_CIDX[name]].add(amount.astype(jnp.int32))
 
-    # ---- phase 0: gather events, quantum barrier -------------------------
-    p = jnp.minimum(st.ptr, T - 1)
+    # ---- phase 0: quantum barrier (on step-entry state) ------------------
+    p0 = jnp.minimum(st.ptr, T - 1)
+    et0 = events[arange_c, p0, 0]
+    not_done0 = et0 != EV_END
+    any_not_done = jnp.any(not_done0)
+    any_active = jnp.any(not_done0 & (st.cycles < st.quantum_end))
+    min_nd = jnp.min(jnp.where(not_done0, st.cycles, INT32_MAX))
+    bumped = (min_nd // Q + 1) * Q
+    quantum_end = jnp.where(any_not_done & ~any_active, bumped, st.quantum_end)
+
+    step_no = st.step
+
+    # ---- phase 0.5: local runs (DESIGN.md §3) ----------------------------
+    # Up to `local_run_len` local events retire per core before the one
+    # arbitrated event below: INS batches, L1 read hits, and L1 write hits
+    # in E/M, judged against the step-start directory (unchanged during
+    # runs) and the core's own live L1 state. Stops at the first non-local
+    # event, the quantum boundary, or the run limit. These are one-hot
+    # lane updates on the core's own row only — no cross-core effects.
+    cycles_c, ptr_c = st.cycles, st.ptr
+    l1_state_c, l1_lru_c = st.l1_state, st.l1_lru
+    run = jnp.ones(C, bool)
+    for _ in range(cfg.local_run_len):
+        pr = jnp.minimum(ptr_c, T - 1)
+        evr = events[arange_c, pr]  # [C, 4]
+        etr, eargr, eaddrr, eprer = evr[:, 0], evr[:, 1], evr[:, 2], evr[:, 3]
+        can = run & (etr != EV_END) & (cycles_c < quantum_end)
+        is_ins_r = can & (etr == EV_INS)
+        line_r = eaddrr >> cfg.line_bits
+        _, tag_rows_r, weff_r = _l1_probe(
+            cfg, arange_c, st.l1_tag, l1_state_c, st.l1_ptr, st.llc_tag,
+            st.llc_owner, st.sharers, line_r,
+        )
+        match_r = (tag_rows_r == line_r[:, None]) & (weff_r != I)
+        hit_any_r = jnp.any(match_r, axis=1)
+        hit_way_r = jnp.argmax(match_r, axis=1).astype(jnp.int32)
+        hit_state_r = weff_r[arange_c, hit_way_r]
+        is_st_r = etr == EV_ST
+        r_hit = can & (etr == EV_LD) & hit_any_r
+        w_hit = can & is_st_r & hit_any_r & (hit_state_r >= E)
+        hit_r = r_hit | w_hit
+        local = is_ins_r | hit_r
+        cycles_c = cycles_c + jnp.where(
+            is_ins_r,
+            eargr * cpi_vec,
+            jnp.where(hit_r, eprer * cpi_vec + cfg.l1.latency, 0),
+        )
+        ptr_c = ptr_c + local.astype(jnp.int32)
+        cnt = cadd(cnt, "l1_read_hits", r_hit)
+        cnt = cadd(cnt, "l1_write_hits", w_hit)
+        cnt = cadd(
+            cnt,
+            "instructions",
+            jnp.where(is_ins_r, eargr, 0) + jnp.where(hit_r, eprer + 1, 0),
+        )
+        set_sel_r = (colr % S1) == (line_r & (S1 - 1))[:, None]
+        hw_sel = set_sel_r & ((colr // S1) == hit_way_r[:, None])
+        l1_lru_c = jnp.where(hit_r[:, None] & hw_sel, step_no, l1_lru_c)
+        l1_state_c = jnp.where(w_hit[:, None] & hw_sel, M, l1_state_c)
+        run = local  # stop at the first non-local event
+
+    # ---- phase 0.9: gather the arbitration-phase events ------------------
+    p = jnp.minimum(ptr_c, T - 1)
     ev = events[arange_c, p]  # [C, 4]
     et, earg, eaddr, epre = ev[:, 0], ev[:, 1], ev[:, 2], ev[:, 3]
     not_done = et != EV_END
-    any_not_done = jnp.any(not_done)
-    any_active = jnp.any(not_done & (st.cycles < st.quantum_end))
-    min_nd = jnp.min(jnp.where(not_done, st.cycles, INT32_MAX))
-    bumped = (min_nd // Q + 1) * Q
-    quantum_end = jnp.where(any_not_done & ~any_active, bumped, st.quantum_end)
-    active = not_done & (st.cycles < quantum_end)
-
-    step_no = st.step
+    active = not_done & (cycles_c < quantum_end)
 
     is_ins = active & (et == EV_INS)
     is_st_ev = et == EV_ST
     is_mem = active & ((et == EV_LD) | is_st_ev)
 
-    # ---- phase 1: L1 lookup + classification (step-start state) ----------
-    # PULL-BASED COHERENCE (the TPU-native shape of MESI): remote
-    # invalidations and downgrades are never pushed into target L1 arrays —
-    # that costs O(C * S1 * W1) table gathers per step. Instead each L1 way
-    # stores only locally-written state, and its EFFECTIVE MESI state is
-    # derived on access by validating against the directory (which phase 4
-    # maintains exactly):
-    #     no local entry, or line absent from LLC          -> I
-    #     directory owner == this core                     -> local state
-    #     this core recorded in the sharer bit-vector      -> S  (covers
-    #                                          probe-downgraded old owners)
-    #     otherwise                                        -> I  (stale)
-    # This is observably equivalent to eager invalidation (same hits,
-    # misses, victims, timings, counters) because every eager invalidation
-    # event is exactly a directory update that this validation re-derives;
-    # the eager golden model + parity tests prove the equivalence on every
-    # workload. See DESIGN.md §7.
+    # ---- phase 1: L1 lookup + classification (post-run state) ------------
     line = eaddr >> cfg.line_bits  # [C] int32 (addresses < 2^31)
     l1s = line & (S1 - 1)
-    # L1 arrays are [C, W1*S1] (column w*S1 + s); pull the accessed set's
-    # per-way columns
-    w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]  # [C,W1]
-    tag_rows = jnp.take_along_axis(st.l1_tag, w1cols, axis=1)  # [C, W1]
-    state_rows = jnp.take_along_axis(st.l1_state, w1cols, axis=1)
-    logB = B.bit_length() - 1
-    n_slots = B * S2
-
-    # validate every resident way of the accessed set against the directory
-    ltag2 = st.llc_tag.reshape(n_slots, W2)
-    lown2 = st.llc_owner.reshape(n_slots, W2)
-    wslot = (tag_rows & (B - 1)) * S2 + ((tag_rows >> logB) & (S2 - 1))  # [C,W1]
-    wllc_tags = ltag2[wslot]  # [C, W1, W2]
-    wmatch = wllc_tags == tag_rows[..., None]
-    whas = jnp.any(wmatch, axis=2)
-    whway = jnp.argmax(wmatch, axis=2).astype(jnp.int32)
-    wowner = jnp.take_along_axis(lown2[wslot], whway[..., None], axis=2)[..., 0]
-    wsh_word = st.sharers[wslot, whway * NW + (arange_c[:, None] >> 5)]  # [C,W1]
-    wshbit = ((wsh_word >> (arange_c[:, None] & 31).astype(jnp.uint32)) & 1) != 0
-    weff = jnp.where(
-        (state_rows == I) | ~whas,
-        I,
-        jnp.where(
-            wowner == arange_c[:, None],
-            state_rows,
-            jnp.where(wshbit, S, I),
-        ),
-    )  # [C, W1] effective MESI per way
+    w1cols, tag_rows, weff = _l1_probe(
+        cfg, arange_c, st.l1_tag, l1_state_c, st.l1_ptr, st.llc_tag,
+        st.llc_owner, st.sharers, line,
+    )
 
     l1_match = (tag_rows == line[:, None]) & (weff != I)
     hit_any = jnp.any(l1_match, axis=1)
@@ -173,7 +250,7 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # targets its home (bank,set) this step; else it demotes to normal GETS.
     join_elig = gets & llc_has & (owner == -1) & other_sharers
     req = (gets & ~join_elig) | getm | upg
-    rel = st.cycles - (quantum_end - Q)  # in [0, Q) for active requesters
+    rel = cycles_c - (quantum_end - Q)  # in [0, Q) for active requesters
     key = rel * C + arange_c  # orders by (cycles, core_id); < Q*C < 2^31
     table = jnp.full(B * S2, INT32_MAX, jnp.int32)
     table = table.at[jnp.where(req, slot, B * S2)].min(key, mode="drop")
@@ -206,7 +283,7 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
 
     # does the owner actually still hold the line? (lazy directory, GETS)
     own_tag_rows = st.l1_tag[oclamp[:, None], w1cols]  # [C, W1]
-    own_state_rows = st.l1_state[oclamp[:, None], w1cols]
+    own_state_rows = l1_state_c[oclamp[:, None], w1cols]
     own_found = jnp.any((own_tag_rows == line[:, None]) & (own_state_rows != I), axis=1)
 
     is_write_req = getm | upg
@@ -303,17 +380,16 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     cnt = cadd(cnt, "l1_read_hits", read_hit)
     cnt = cadd(cnt, "l1_write_hits", write_hit)
     retired = is_ins | hit | winner | join
-    cpi_vec = jnp.asarray(cfg.core.cpi_vector(C), jnp.int32)
     mem_ret = hit | winner | join
     mem_lat = jnp.where(
         hit, cfg.l1.latency, jnp.where(join, lat_join, lat)
     )
-    cycles = st.cycles + jnp.where(
+    cycles = cycles_c + jnp.where(
         is_ins,
         earg * cpi_vec,
         jnp.where(mem_ret, epre * cpi_vec + mem_lat, 0),
     )
-    ptr = st.ptr + retired.astype(jnp.int32)
+    ptr = ptr_c + retired.astype(jnp.int32)
     cnt = cadd(
         cnt,
         "instructions",
@@ -326,12 +402,11 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # L1 hit refresh (+ silent E->M): row index is the core itself, so the
     # update is a [C,S1,W1] one-hot select
     # (L1 arrays are [C, W1*S1]: column = way*S1 + set)
-    colr = jnp.arange(W1 * S1, dtype=jnp.int32)[None, :]  # [1, W1*S1]
     set_sel = (colr % S1) == l1s[:, None]  # [C, W1*S1] this-set columns
     hitway_sel = set_sel & ((colr // S1) == hit_way[:, None])
     sel_hit = hit[:, None] & hitway_sel
-    l1_lru = jnp.where(sel_hit, step_no, st.l1_lru)
-    l1_state = jnp.where(write_hit[:, None] & hitway_sel, M, st.l1_state)
+    l1_lru = jnp.where(sel_hit, step_no, l1_lru_c)
+    l1_state = jnp.where(write_hit[:, None] & hitway_sel, M, l1_state_c)
     l1_tag = st.l1_tag
 
     # winner L1 update: UPG-in-place vs fill. Victim preference counts
@@ -339,7 +414,7 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # invalid-first rule; the victim writeback fires only on EFFECTIVE M.
     upg_in_place = upg & winner  # upg requires an L1 hit: always in-place
     fill = (winner & ~upg_in_place) | join
-    lru_rows = jnp.take_along_axis(st.l1_lru, w1cols, axis=1)  # [C, W1]
+    lru_rows = jnp.take_along_axis(l1_lru_c, w1cols, axis=1)  # [C, W1]
     l1_vkey = jnp.where(weff == I, -1, lru_rows)
     l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
     cnt = cadd(cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M))
@@ -357,6 +432,10 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     l1_tag = jnp.where(sel_w, line[:, None], l1_tag)
     l1_state = jnp.where(sel_w, grant[:, None], l1_state)
     l1_lru = jnp.where(sel_w, step_no, l1_lru)
+    # record the filled line's directory entry position (way pointer);
+    # joins and LLC hits fill at the line's hit way, misses at the victim
+    fill_ptr = slot * W2 + jnp.where(join | llc_hit, llc_hway, llc_vway)
+    l1_ptr = jnp.where(sel_w, fill_ptr[:, None], st.l1_ptr)
 
     # LLC entry update: scatter the C winners' rows (collision-free: one
     # winner per (bank,set)) — scattering C updates beats gathering for all
@@ -432,6 +511,7 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         l1_tag=l1_tag,
         l1_state=l1_state,
         l1_lru=l1_lru,
+        l1_ptr=l1_ptr,
         llc_tag=llc_tag_n,
         llc_owner=llc_owner_n,
         llc_lru=llc_lru_n,
@@ -453,13 +533,78 @@ def run_chunk(cfg: MachineConfig, n_steps: int, events, st: MachineState):
     return st
 
 
-class Engine:
-    """Chunked host runner (SURVEY.md §2 #8 UncoreManager equivalent).
+def _device_done(events, st, arange_c):
+    T = events.shape[1]
+    p = jnp.minimum(st.ptr, T - 1)
+    return jnp.all(events[arange_c, p, 0] == EV_END)
 
-    Runs jitted scan chunks, and between chunks: checks termination, drains
-    int32 device counters into int64 host accumulators, and rebases the
-    epoch-relative clocks (by a multiple of the quantum, preserving barrier
-    arithmetic) so int32 never overflows.
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
+             max_chunks):
+    """ONE dispatched device program for a whole simulation run.
+
+    `lax.while_loop` over scan chunks; after each chunk, ON DEVICE: drain
+    int32 step counters into (lo, hi) int32 accumulator pairs (hi carries
+    above 2^30, so per-chunk per-core increments must stay < 2^30), rebase
+    the epoch-relative clocks by a multiple of the quantum (preserving
+    barrier arithmetic) so int32 never overflows, and test termination.
+    This replaces the reference's per-quantum MPI barrier + host polling
+    (SURVEY.md §3.4) with zero host round-trips until the run completes.
+    """
+    C = cfg.n_cores
+    Q = cfg.quantum
+    T = events.shape[1]
+    arange_c = jnp.arange(C, dtype=jnp.int32)
+
+    def cond(carry):
+        st, acc_lo, acc_hi, base_lo, base_hi, k = carry
+        return (k < max_chunks) & ~_device_done(events, st, arange_c)
+
+    def body(carry):
+        st, acc_lo, acc_hi, base_lo, base_hi, k = carry
+
+        def sbody(c, _):
+            return step(cfg, events, c), None
+
+        st, _ = jax.lax.scan(sbody, st, None, length=chunk_steps)
+        # drain counters (lo/hi pair; both stay < 2^31)
+        acc_lo = acc_lo + st.counters
+        acc_hi = acc_hi + (acc_lo >> _ACC_BITS)
+        acc_lo = acc_lo & ((1 << _ACC_BITS) - 1)
+        st = st._replace(counters=jnp.zeros_like(st.counters))
+        # rebase clocks by a whole number of quanta
+        p = jnp.minimum(st.ptr, T - 1)
+        nd = events[arange_c, p, 0] != EV_END
+        m = jnp.min(jnp.where(nd, st.cycles, INT32_MAX))
+        delta = jnp.where(jnp.any(nd), (m // Q) * Q, 0)
+        st = st._replace(
+            cycles=st.cycles - delta, quantum_end=st.quantum_end - delta
+        )
+        base_lo = base_lo + delta
+        base_hi = base_hi + (base_lo >> _ACC_BITS)
+        base_lo = base_lo & ((1 << _ACC_BITS) - 1)
+        return st, acc_lo, acc_hi, base_lo, base_hi, k + 1
+
+    acc_lo = jnp.zeros_like(st.counters)
+    acc_hi = jnp.zeros_like(st.counters)
+    base_lo = jnp.asarray(0, jnp.int32)
+    base_hi = jnp.asarray(0, jnp.int32)
+    k = jnp.asarray(0, jnp.int32)
+    return jax.lax.while_loop(
+        cond, body, (st, acc_lo, acc_hi, base_lo, base_hi, k)
+    )
+
+
+class Engine:
+    """Host runner (SURVEY.md §2 #8 UncoreManager equivalent).
+
+    `run()` dispatches the whole simulation as ONE device program
+    (`run_loop`) and makes a single synchronizing host transfer at the end —
+    per-dispatch latency through remote-TPU tunnels is tens of ms, so chunked
+    host loops (`run_chunked`, kept for debugging/inspection) are wall-clock
+    poison. Between-chunk bookkeeping (counter drain to 64-bit, quantum
+    rebase of the int32 clocks, termination) happens on device either way.
     """
 
     def __init__(
@@ -516,6 +661,36 @@ class Engine:
         return bool((self._event_types_at_ptr() == EV_END).all())
 
     def run(self, max_steps: int = 10_000_000) -> None:
+        """Run to completion in ONE device dispatch (preferred path)."""
+        max_chunks = -(-max_steps // self.chunk_steps)
+        st, acc_lo, acc_hi, base_lo, base_hi, k = run_loop(
+            self.cfg,
+            self.chunk_steps,
+            self.events,
+            self.state,
+            jnp.asarray(max_chunks, jnp.int32),
+        )
+        # one synchronizing transfer for everything the host needs
+        acc_lo = np.asarray(acc_lo).astype(np.int64)
+        acc_hi = np.asarray(acc_hi).astype(np.int64)
+        total = (acc_hi << _ACC_BITS) + acc_lo
+        for i, name in enumerate(COUNTER_NAMES):
+            self.host_counters[name] += total[i]
+        self.cycle_base += (np.int64(np.asarray(base_hi)) << _ACC_BITS) + np.int64(
+            np.asarray(base_lo)
+        )
+        self.state = st
+        self.steps_run += int(np.asarray(k)) * self.chunk_steps
+        if not self.done():
+            raise RuntimeError("engine: max_steps exceeded (deadlock?)")
+
+    def run_chunked(self, max_steps: int = 10_000_000) -> None:
+        """Host-loop variant: one dispatch per chunk + host drain/rebase.
+
+        Semantically identical to `run()`; kept for debugging (state is
+        inspectable between chunks) and as the reference for the fused
+        loop's on-device bookkeeping.
+        """
         while self.steps_run < max_steps and not self.done():
             self.state = run_chunk(self.cfg, self.chunk_steps, self.events, self.state)
             self.steps_run += self.chunk_steps

@@ -35,6 +35,12 @@ class MachineState(NamedTuple):
     l1_tag: jnp.ndarray  # [C, W1*S1] int32, -1 = invalid
     l1_state: jnp.ndarray  # [C, W1*S1] int32 MESI (locally-written)
     l1_lru: jnp.ndarray  # [C, W1*S1] int32 step-stamp
+    # LLC way pointer recorded at fill time: slot*W2 + way of the line's
+    # directory entry. Lets the phase-1 pull-validation use three 1-element
+    # gathers instead of W2-wide tag searches (engine.py `_l1_probe`); a
+    # stale pointer is self-detecting (the pointed tag no longer matches)
+    # and exactly reproduces search validation — see DESIGN.md §7.
+    l1_ptr: jnp.ndarray  # [C, W1*S1] int32
     # LLC banks + directory
     llc_tag: jnp.ndarray  # [B, S2, W2] int32, -1 = invalid
     llc_owner: jnp.ndarray  # [B, S2, W2] int32 core id or -1
@@ -70,6 +76,7 @@ def init_state(cfg: MachineConfig) -> MachineState:
         l1_tag=jnp.full((C, w1 * s1), -1, jnp.int32),
         l1_state=jnp.full((C, w1 * s1), I, jnp.int32),
         l1_lru=jnp.zeros((C, w1 * s1), jnp.int32),
+        l1_ptr=jnp.zeros((C, w1 * s1), jnp.int32),
         llc_tag=jnp.full((B, s2, w2), -1, jnp.int32),
         llc_owner=jnp.full((B, s2, w2), -1, jnp.int32),
         llc_lru=jnp.zeros((B, s2, w2), jnp.int32),

@@ -1,0 +1,114 @@
+"""Bisect per-step cost: stub out pieces of engine.step via source surgery."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import primesim_tpu.sim.engine as eng_mod
+from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+from primesim_tpu.sim.state import init_state
+from primesim_tpu.trace import synth
+from primesim_tpu.trace.format import fold_ins
+
+SRC = open(eng_mod.__file__).read()
+
+VARIANTS = {
+    "full": [],
+    "no_sharers_scatter": [
+        ('sharers_n = st.sharers.at[wslot_upd].set(new_row, mode="drop")',
+         "sharers_n = st.sharers"),
+        ('sharers_n = sharers_n.at[jslot].add(join_row, mode="drop")',
+         "sharers_n = sharers_n"),
+    ],
+    "no_llc_scatter": [
+        ('llc_tag_n = st.llc_tag.at[wbank, bset, llc_uway].set(line, mode="drop")',
+         "llc_tag_n = st.llc_tag"),
+        ('llc_lru_n = st.llc_lru.at[wbank, bset, llc_uway].set(step_no, mode="drop")',
+         "llc_lru_n = st.llc_lru"),
+        ('llc_owner_n = st.llc_owner.at[wbank, bset, llc_uway].set(new_owner, mode="drop")',
+         "llc_owner_n = st.llc_owner"),
+        ("llc_lru_n = llc_lru_n.at[\n        jnp.where(join, bank, B), bset, llc_hway\n    ].max(step_no, mode=\"drop\")",
+         "llc_lru_n = llc_lru_n"),
+    ],
+    "no_unpack_CC": [
+        ("    sh_bits = unpack_bits(shw)",
+         "    sh_bits = jnp.zeros((C, C), bool)"),
+        ("    vic_sh_bits = unpack_bits(vic_shw)",
+         "    vic_sh_bits = jnp.zeros((C, C), bool)"),
+    ],
+    "no_CC_reductions": [
+        ("    inv_lat = jnp.max(jnp.where(inv_pairs, 2 * pair_lat, 0), axis=1)",
+         "    inv_lat = jnp.zeros(C, jnp.int32)"),
+        ("    inv_count = jnp.sum(inv_pairs, axis=1).astype(jnp.int32)",
+         "    inv_count = jnp.zeros(C, jnp.int32)"),
+        ("    inv_hops = jnp.sum(jnp.where(inv_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
+         "    inv_hops = jnp.zeros(C, jnp.int32)"),
+        ("    back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)",
+         "    back_count = jnp.zeros(C, jnp.int32)"),
+        ("    back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
+         "    back_hops = jnp.zeros(C, jnp.int32)"),
+    ],
+    "no_arb_table": [
+        ('    table = table.at[jnp.where(req, slot, B * S2)].min(key, mode="drop")',
+         "    table = table"),
+        ('    table = table.at[jnp.where(demoted, slot, B * S2)].min(key, mode="drop")',
+         "    table = table"),
+    ],
+    "no_l1_selects": [
+        ("    l1_lru = jnp.where(sel_hit, step_no, st.l1_lru)",
+         "    l1_lru = st.l1_lru"),
+        ("    l1_state = jnp.where(write_hit[:, None] & hitway_sel, M, st.l1_state)",
+         "    l1_state = st.l1_state"),
+        ("    l1_tag = jnp.where(dup2, -1, l1_tag)", "    l1_tag = l1_tag"),
+        ("    l1_state = jnp.where(dup2, I, l1_state)", "    l1_state = l1_state"),
+        ("    l1_tag = jnp.where(sel_w, line[:, None], l1_tag)", "    l1_tag = l1_tag"),
+        ("    l1_state = jnp.where(sel_w, grant[:, None], l1_state)", "    l1_state = l1_state"),
+        ("    l1_lru = jnp.where(sel_w, step_no, l1_lru)", "    l1_lru = l1_lru"),
+    ],
+    "no_phase1_validation": [
+        # effective state = local state (skip directory validation gathers)
+        ("    weff = jnp.where(\n        (state_rows == I) | ~whas,\n        I,\n        jnp.where(\n            wowner == arange_c[:, None],\n            state_rows,\n            jnp.where(wshbit, S, I),\n        ),\n    )  # [C, W1] effective MESI per way",
+         "    weff = state_rows"),
+    ],
+}
+
+
+def build(name):
+    src = SRC
+    for old, new in VARIANTS[name]:
+        assert old in src, f"{name}: pattern not found: {old[:60]!r}"
+        src = src.replace(old, new)
+    ns = {
+        "__name__": f"primesim_tpu.sim.engine_{name}",
+        "__package__": "primesim_tpu.sim",
+        "__file__": eng_mod.__file__,
+    }
+    exec(compile(src, eng_mod.__file__, "exec"), ns)
+    return ns["run_chunk"]
+
+
+def main():
+    C = 1024
+    cfg = MachineConfig(n_cores=C, n_banks=C,
+        l1=CacheConfig(size=32 * 1024, ways=4, line=64, latency=2),
+        llc=CacheConfig(size=256 * 1024, ways=8, line=64, latency=10),
+        noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
+        dram_lat=100, quantum=1000)
+    trace = fold_ins(synth.fft_like(C, n_phases=2, points_per_core=16, ins_per_mem=8, seed=42))
+    events = jnp.asarray(trace.events)
+    n = 256
+    for name in VARIANTS:
+        rc = build(name)
+        st = init_state(cfg)
+        out = rc(cfg, n, events, st); np.asarray(out.step)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = rc(cfg, n, events, out)
+        np.asarray(out.step)
+        dt = (time.perf_counter() - t0) / 3
+        print(f"[{name:22s}] {(dt*1e3-36)/n:.3f} ms/step (call {dt*1e3:.0f}ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Ad-hoc step profiler: where do the 2.7 ms go at 1024 cores?"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+from primesim_tpu.sim.engine import run_chunk
+from primesim_tpu.sim.state import init_state
+from primesim_tpu.trace import synth
+from primesim_tpu.trace.format import fold_ins
+
+
+def bench_cfg(C=1024, llc_kb=256):
+    return MachineConfig(
+        n_cores=C,
+        n_banks=C,
+        l1=CacheConfig(size=32 * 1024, ways=4, line=64, latency=2),
+        llc=CacheConfig(size=llc_kb * 1024, ways=8, line=64, latency=10),
+        noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
+        dram_lat=100,
+        quantum=1000,
+    )
+
+
+def time_chunk(cfg, n_steps=64, tag=""):
+    trace = fold_ins(synth.fft_like(cfg.n_cores, n_phases=4, points_per_core=256,
+                                    ins_per_mem=8, seed=42))
+    events = jnp.asarray(trace.events)
+    st = init_state(cfg)
+    lowered = jax.jit(
+        lambda ev, s: run_chunk(cfg, n_steps, ev, s)
+    ).lower(events, st)
+    compiled = lowered.compile()
+    st2 = jax.block_until_ready(compiled(events, st))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        st2 = jax.block_until_ready(compiled(events, st2))
+    dt = (time.perf_counter() - t0) / 3 / n_steps
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    print(f"[{tag}] {dt*1e3:.3f} ms/step | flops={ca.get('flops',0)/n_steps/1e6:.1f}M "
+          f"bytes={ca.get('bytes accessed',0)/n_steps/1e6:.1f}MB/step")
+    return dt
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices())
+    time_chunk(bench_cfg(1024), tag="1024c full")
+    time_chunk(bench_cfg(1024, llc_kb=64), tag="1024c llc64KB (1/4 sets)")
+    time_chunk(bench_cfg(256), tag="256c full")

@@ -132,6 +132,22 @@ def test_parity_o3_overlap():
     assert_parity(cfg, GENS["fft_like"](8))
 
 
+@pytest.mark.parametrize("gen", sorted(GENS))
+def test_parity_local_runs(gen):
+    # local_run_len > 0: cores retire runs of INS/L1-hit events before the
+    # arbitrated event each step (DESIGN.md §3 "local runs"); must stay
+    # bit-exact vs golden on every generator
+    cfg = machine(8, local_run_len=4)
+    assert_parity(cfg, GENS[gen](8))
+
+
+def test_parity_local_runs_folded_small_quantum():
+    from primesim_tpu.trace.format import fold_ins
+
+    cfg = machine(16, n_banks=4, quantum=64, local_run_len=8)
+    assert_parity(cfg, fold_ins(GENS["fft_like"](16)), chunk_steps=50)
+
+
 def test_parity_single_core():
     cfg = machine(1, n_banks=1, noc=NocConfig(mesh_x=1, mesh_y=1))
     assert_parity(cfg, GENS["pointer_chase"](1))
