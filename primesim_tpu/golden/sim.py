@@ -298,13 +298,18 @@ class GoldenSim:
                         lat += self._noc(c, btile, otile)
                         lat += self._noc(c, otile, btile)
                         self.counters["probes"][c] += 1
-                        found = self._probe_found(l1_state0, l1_tag0, owner, line)
                         phase_b.append((owner, line, "downgrade"))
                         self.llc_owner[b, bs, w] = -1
                         self._clear_sharers(b, bs, w)
                         self._set_sharer(b, bs, w, c, True)
-                        if found:
-                            self._set_sharer(b, bs, w, owner, True)
+                        # The directory cannot observe silent L1 evictions,
+                        # so the probed owner is conservatively re-recorded
+                        # as a sharer whether or not it still holds the line
+                        # (recorded sharers stay a superset of holders) —
+                        # exactly what a real home node does, and it keeps
+                        # the home-side transition free of any read of the
+                        # owner's private cache state.
+                        self._set_sharer(b, bs, w, owner, True)
                         grant = S
                     elif shl:
                         self._set_sharer(b, bs, w, c, True)
@@ -478,15 +483,6 @@ class GoldenSim:
     def _llc_valid(self, llc_tag0, b, bs):
         """Map tags to pseudo-states for victim selection (valid=1, I=0)."""
         return [I if llc_tag0[b, bs, w] == -1 else S for w in range(self.cfg.llc.ways)]
-
-    @staticmethod
-    def _probe_found(l1_state0, l1_tag0, owner, line):
-        sets = l1_tag0.shape[1]
-        s = line % sets
-        for wy in range(l1_tag0.shape[2]):
-            if l1_tag0[owner, s, wy] == line and l1_state0[owner, s, wy] != I:
-                return True
-        return False
 
     def _sharers_from(self, sharers0, b, s, w) -> list[int]:
         out = []

@@ -77,10 +77,17 @@ def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
     W2-wide tag search of the home set; a stale pointer self-detects by
     tag mismatch and yields exactly the search result (DESIGN.md §7).
 
+    The pointer is decomposed into (bank, set, way) coordinates and the
+    gathers index the LLC/sharer arrays in their NATIVE layouts: a
+    `reshape(-1)` flat view of a TPU-tiled array is a physical relayout —
+    XLA materializes a full copy of the (537 MB at 1024 cores) sharers
+    array every step, the round-2 perf regression.
+
     Returns (w1cols, tag_rows, weff): the set's column indices, tags, and
     effective per-way MESI states, all [C, W1].
     """
     S1, W1 = cfg.l1.sets, cfg.l1.ways
+    S2, W2 = cfg.llc.sets, cfg.llc.ways
     NW = cfg.n_sharer_words
     l1s = line & (S1 - 1)
     # L1 arrays are [C, W1*S1] (column w*S1 + s); pull the accessed set's
@@ -89,9 +96,13 @@ def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
     tag_rows = jnp.take_along_axis(l1_tag, w1cols, axis=1)  # [C, W1]
     state_rows = jnp.take_along_axis(l1_state, w1cols, axis=1)
     ptr_rows = jnp.take_along_axis(l1_ptr, w1cols, axis=1)  # [C, W1]
-    vtag = llc_tag.reshape(-1)[ptr_rows]  # [C, W1]
-    vown = llc_owner.reshape(-1)[ptr_rows]
-    vsh = sharers.reshape(-1)[ptr_rows * NW + (arange_c[:, None] >> 5)]
+    pway = ptr_rows % W2  # ptr = (bank*S2 + set)*W2 + way
+    pslot = ptr_rows // W2
+    pbank = pslot // S2
+    pbset = pslot % S2
+    vtag = llc_tag[pbank, pbset, pway]  # [C, W1]
+    vown = llc_owner[pbank, pbset, pway]
+    vsh = sharers[pslot, pway * NW + (arange_c[:, None] >> 5)]
     vbit = ((vsh >> (arange_c[:, None] & 31).astype(jnp.uint32)) & 1) != 0
     weff = jnp.where(
         (state_rows == I) | (vtag != tag_rows),
@@ -105,6 +116,47 @@ def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
     return w1cols, tag_rows, weff
 
 
+def _l1_probe_hit(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
+                  llc_tag, llc_owner, sharers, line):
+    """Hit-only probe: effective MESI state of the (unique) tag-matching way.
+
+    Local runs never fill, so they don't need victim validation; probing
+    only the matching way turns the full probe's three [C, W1] gathers into
+    three [C] gathers. Tags are unique per set (the fill path clears stale
+    duplicates), so the locally-matching way is the only hit candidate, and
+    a way whose local state is I validates to I either way — hit/miss and
+    hit-state agree exactly with `_l1_probe`.
+
+    Returns (hit_any, hit_state, hit_col): effective hit mask, the matching
+    way's effective state, and its flat L1 column (way*S1 + set), all [C].
+    """
+    S1, W1 = cfg.l1.sets, cfg.l1.ways
+    S2, W2 = cfg.llc.sets, cfg.llc.ways
+    NW = cfg.n_sharer_words
+    l1s = line & (S1 - 1)
+    w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]
+    tag_rows = jnp.take_along_axis(l1_tag, w1cols, axis=1)  # [C, W1]
+    state_rows = jnp.take_along_axis(l1_state, w1cols, axis=1)
+    lmatch = (tag_rows == line[:, None]) & (state_rows != I)
+    lhit = jnp.any(lmatch, axis=1)
+    lway = jnp.argmax(lmatch, axis=1).astype(jnp.int32)
+    hit_col = lway * S1 + l1s
+    lstate = state_rows[arange_c, lway]
+    ptr = l1_ptr[arange_c, hit_col]  # [C]
+    pway = ptr % W2
+    pslot = ptr // W2
+    vtag = llc_tag[pslot // S2, pslot % S2, pway]  # [C]
+    vown = llc_owner[pslot // S2, pslot % S2, pway]
+    vsh = sharers[pslot, pway * NW + (arange_c >> 5)]
+    vbit = ((vsh >> (arange_c & 31).astype(jnp.uint32)) & 1) != 0
+    eff = jnp.where(
+        ~lhit | (vtag != line),
+        I,
+        jnp.where(vown == arange_c, lstate, jnp.where(vbit, S, I)),
+    )
+    return eff != I, eff, hit_col
+
+
 def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineState:
     C = cfg.n_cores
     B = cfg.n_banks
@@ -116,8 +168,6 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     n_tiles = cfg.n_tiles
     arange_c = jnp.arange(C, dtype=jnp.int32)
     cpi_vec = jnp.asarray(cfg.core.cpi_vector(C), jnp.int32)
-    colr = jnp.arange(W1 * S1, dtype=jnp.int32)[None, :]  # [1, W1*S1]
-
     cnt = st.counters
 
     def cadd(cnt, name, amount):
@@ -152,14 +202,10 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         can = run & (etr != EV_END) & (cycles_c < quantum_end)
         is_ins_r = can & (etr == EV_INS)
         line_r = eaddrr >> cfg.line_bits
-        _, tag_rows_r, weff_r = _l1_probe(
+        hit_any_r, hit_state_r, hit_col_r = _l1_probe_hit(
             cfg, arange_c, st.l1_tag, l1_state_c, st.l1_ptr, st.llc_tag,
             st.llc_owner, st.sharers, line_r,
         )
-        match_r = (tag_rows_r == line_r[:, None]) & (weff_r != I)
-        hit_any_r = jnp.any(match_r, axis=1)
-        hit_way_r = jnp.argmax(match_r, axis=1).astype(jnp.int32)
-        hit_state_r = weff_r[arange_c, hit_way_r]
         is_st_r = etr == EV_ST
         r_hit = can & (etr == EV_LD) & hit_any_r
         w_hit = can & is_st_r & hit_any_r & (hit_state_r >= E)
@@ -178,10 +224,13 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
             "instructions",
             jnp.where(is_ins_r, eargr, 0) + jnp.where(hit_r, eprer + 1, 0),
         )
-        set_sel_r = (colr % S1) == (line_r & (S1 - 1))[:, None]
-        hw_sel = set_sel_r & ((colr // S1) == hit_way_r[:, None])
-        l1_lru_c = jnp.where(hit_r[:, None] & hw_sel, step_no, l1_lru_c)
-        l1_state_c = jnp.where(w_hit[:, None] & hw_sel, M, l1_state_c)
+        # one-hot row updates as [C]-element scatters (drop masked lanes)
+        l1_lru_c = l1_lru_c.at[
+            jnp.where(hit_r, arange_c, C), hit_col_r
+        ].set(step_no, mode="drop")
+        l1_state_c = l1_state_c.at[
+            jnp.where(w_hit, arange_c, C), hit_col_r
+        ].set(M, mode="drop")
         run = local  # stop at the first non-local event
 
     # ---- phase 0.9: gather the arbitration-phase events ------------------
@@ -280,11 +329,6 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     oclamp = jnp.maximum(owner, 0)
     otile = oclamp % n_tiles
     po_lat, po_hops = _one_way(btile, otile, cfg)  # bank -> owner (symmetric back)
-
-    # does the owner actually still hold the line? (lazy directory, GETS)
-    own_tag_rows = st.l1_tag[oclamp[:, None], w1cols]  # [C, W1]
-    own_state_rows = l1_state_c[oclamp[:, None], w1cols]
-    own_found = jnp.any((own_tag_rows == line[:, None]) & (own_state_rows != I), axis=1)
 
     is_write_req = getm | upg
     gets_w = gets & winner
@@ -396,18 +440,12 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         jnp.where(is_ins, earg, 0) + jnp.where(mem_ret, epre + 1, 0),
     )
 
-    # L1-side updates are branchless one-hot selects (row index = own core);
-    # LLC-side updates scatter one row per winner (collision-free).
-
-    # L1 hit refresh (+ silent E->M): row index is the core itself, so the
-    # update is a [C,S1,W1] one-hot select
-    # (L1 arrays are [C, W1*S1]: column = way*S1 + set)
-    set_sel = (colr % S1) == l1s[:, None]  # [C, W1*S1] this-set columns
-    hitway_sel = set_sel & ((colr // S1) == hit_way[:, None])
-    sel_hit = hit[:, None] & hitway_sel
-    l1_lru = jnp.where(sel_hit, step_no, l1_lru_c)
-    l1_state = jnp.where(write_hit[:, None] & hitway_sel, M, l1_state_c)
-    l1_tag = st.l1_tag
+    # L1-side updates touch at most TWO (row, column) slots per core — the
+    # retired way, and (for fills) a stale duplicate of the filled tag —
+    # so each is a [C]-element scatter into the [C, W1*S1] arrays, not a
+    # full-array one-hot select (which rewrites 4x8MB per step at 1024
+    # cores). Rows are the core's own, columns flat way*S1 + set; masked
+    # lanes scatter to dropped row C.
 
     # winner L1 update: UPG-in-place vs fill. Victim preference counts
     # directory-invalidated (stale) ways as free, matching eager-MESI's
@@ -419,23 +457,37 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
     cnt = cadd(cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M))
     upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
-    updway_sel = set_sel & ((colr // S1) == upd_way[:, None])
-    sel_w = (winner | join)[:, None] & updway_sel
+    hit_col = hit_way * S1 + l1s
+    upd_col = upd_way * S1 + l1s
+
     # a fill may duplicate a stale way's tag: clear the stale copy so tags
     # stay unique per set (else the refill could "resurrect" it, since the
-    # directory once again records this core for the line)
-    dup2 = (
-        fill[:, None] & set_sel & (l1_tag == line[:, None]) & ~updway_sel
-    )
-    l1_tag = jnp.where(dup2, -1, l1_tag)
-    l1_state = jnp.where(dup2, I, l1_state)
-    l1_tag = jnp.where(sel_w, line[:, None], l1_tag)
-    l1_state = jnp.where(sel_w, grant[:, None], l1_state)
-    l1_lru = jnp.where(sel_w, step_no, l1_lru)
+    # directory once again records this core for the line); uniqueness also
+    # means at most one duplicate way exists
+    tagm = tag_rows == line[:, None]  # [C, W1], any state
+    t_way = jnp.argmax(tagm, axis=1).astype(jnp.int32)
+    dup = fill & jnp.any(tagm, axis=1) & (t_way != upd_way)
+    dup_row = jnp.where(dup, arange_c, C)
+    dup_col = t_way * S1 + l1s
+    l1_tag = st.l1_tag.at[dup_row, dup_col].set(-1, mode="drop")
+    l1_state = l1_state_c.at[dup_row, dup_col].set(I, mode="drop")
+
+    # hit refresh + winner/join fill in one scatter per array (a core
+    # retires as a hit OR a winner/join, never both, so rows are disjoint)
+    wj = winner | join
+    lru_row = jnp.where(hit | wj, arange_c, C)
+    lru_col = jnp.where(hit, hit_col, upd_col)
+    l1_lru = l1_lru_c.at[lru_row, lru_col].set(step_no, mode="drop")
+    st_row = jnp.where(write_hit | wj, arange_c, C)  # silent E->M + grants
+    st_col = jnp.where(write_hit, hit_col, upd_col)
+    st_val = jnp.where(write_hit, M, grant)
+    l1_state = l1_state.at[st_row, st_col].set(st_val, mode="drop")
+    wj_row = jnp.where(wj, arange_c, C)
+    l1_tag = l1_tag.at[wj_row, upd_col].set(line, mode="drop")
     # record the filled line's directory entry position (way pointer);
     # joins and LLC hits fill at the line's hit way, misses at the victim
     fill_ptr = slot * W2 + jnp.where(join | llc_hit, llc_hway, llc_vway)
-    l1_ptr = jnp.where(sel_w, fill_ptr[:, None], st.l1_ptr)
+    l1_ptr = st.l1_ptr.at[wj_row, upd_col].set(fill_ptr, mode="drop")
 
     # LLC entry update: scatter the C winners' rows (collision-free: one
     # winner per (bank,set)) — scattering C updates beats gathering for all
@@ -444,7 +496,12 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     new_owner = jnp.where(write_w | gets_excl_hit | llc_miss, arange_c, -1)
     wbank = jnp.where(winner, bank, B)
     llc_tag_n = st.llc_tag.at[wbank, bset, llc_uway].set(line, mode="drop")
-    llc_lru_n = st.llc_lru.at[wbank, bset, llc_uway].set(step_no, mode="drop")
+    # LRU stamps cover winners AND joins in one scatter (join refresh at the
+    # hit way; step_no > every earlier stamp so set == max, and same-slot
+    # joiners write identical values)
+    lru_bank = jnp.where(winner | join, bank, B)
+    lru_way = jnp.where(join, llc_hway, llc_uway)
+    llc_lru_n = st.llc_lru.at[lru_bank, bset, lru_way].set(step_no, mode="drop")
     llc_owner_n = st.llc_owner.at[wbank, bset, llc_uway].set(new_owner, mode="drop")
 
     # new sharer words [C, NW]
@@ -452,8 +509,12 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         (jnp.arange(NW)[None, :] == word_idx[:, None]).astype(jnp.uint32)
         << bit_idx[:, None]
     )  # bit(c) as packed words
+    # the probed owner is re-recorded as a sharer unconditionally: the home
+    # node cannot observe silent L1 evictions (golden does the same), and
+    # this keeps the transition free of cross-core L1 reads — which under
+    # core-axis sharding would all-gather the L1 arrays every step
     owner_word = jnp.where(
-        (jnp.arange(NW)[None, :] == (oclamp // 32)[:, None]) & own_found[:, None],
+        jnp.arange(NW)[None, :] == (oclamp // 32)[:, None],
         jnp.uint32(1) << (oclamp % 32).astype(jnp.uint32)[:, None],
         jnp.uint32(0),
     )
@@ -466,40 +527,40 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
             jnp.zeros_like(shw),  # M grants, E grants, misses: cleared
         ),
     )
-    # rewrite only the winner's way segment within its row, scatter the row
+    # ONE combined scatter-add updates winner AND join rows (they are
+    # disjoint: join slots never have a winner). Winner rows contribute the
+    # full-row delta (new_row - old_row; exactly one winner per slot, so
+    # old + delta == new, wrap-safe in uint32). Join rows contribute only
+    # the joiner's own bit, masked against the step-start word
+    # (self_word & ~shw): a silently-evicted sharer that re-joins still has
+    # its stale bit recorded, and an unmasked add would carry into the
+    # adjacent bit — golden's _set_sharer is idempotent, so the masked add
+    # matches it. Multiple joiners per slot add distinct bits. A single
+    # scatter traverses the (huge) sharers array's update path once, not
+    # twice.
     way_seg = (
         jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_uway[:, None]
     )
+    old_flat = sh_rows.reshape(C, W2 * NW)
     new_row = jnp.where(
         way_seg,
         jnp.broadcast_to(new_shw[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
-        sh_rows.reshape(C, W2 * NW),
+        old_flat,
     )
-    wslot_upd = jnp.where(winner, slot, B * S2)
-    sharers_n = st.sharers.at[wslot_upd].set(new_row, mode="drop")
-
-    # join LLC updates: sharer bits accumulate by scatter-ADD (each joiner
-    # contributes a distinct bit, and join slots never have a winner, so
-    # the adds are collision-free w.r.t. the winner row writes above);
-    # LRU refresh via scatter-max (idempotent across same-slot joiners).
-    # Mask out bits already set in the step-start word (self_word & ~shw):
-    # a silently-evicted sharer that re-joins still has its stale bit
-    # recorded, and an unmasked add would carry into the adjacent bit —
-    # golden's _set_sharer is idempotent, so the masked add matches it.
     join_seg = (
         jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_hway[:, None]
     )
     join_word = self_word & ~shw  # carry-free when the bit is already set
     join_row = jnp.where(
-        join_seg & join[:, None],
+        join_seg,
         jnp.broadcast_to(join_word[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
         jnp.uint32(0),
     )
-    jslot = jnp.where(join, slot, B * S2)
-    sharers_n = sharers_n.at[jslot].add(join_row, mode="drop")
-    llc_lru_n = llc_lru_n.at[
-        jnp.where(join, bank, B), bset, llc_hway
-    ].max(step_no, mode="drop")
+    delta_row = jnp.where(
+        winner[:, None], new_row - old_flat, join_row
+    )
+    upd_slot = jnp.where(winner | join, slot, B * S2)
+    sharers_n = st.sharers.at[upd_slot].add(delta_row, mode="drop")
 
     # No phase 4.B: under pull-based coherence, the directory updates above
     # ARE the invalidations/downgrades — remote L1s re-derive their state on

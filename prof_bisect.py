@@ -1,4 +1,13 @@
-"""Bisect per-step cost: stub out pieces of engine.step via source surgery."""
+"""Bisect per-step cost: stub out pieces of engine.step via source surgery.
+
+Each variant knocks out ONE piece of the step (replacing it with a cheap
+stand-in of the same shape) and times a 256-step `run_chunk` at the
+flagship 1024-core config. The simulated behavior diverges under ablation
+(that's fine — step cost is shape-static, not data-dependent), so this is
+a TIMING tool only. Patterns are exact substrings of the current
+`engine.py`; `build()` asserts they still exist so the tool rots loudly,
+not silently (round-2 lesson).
+"""
 import time
 
 import jax
@@ -16,20 +25,16 @@ SRC = open(eng_mod.__file__).read()
 VARIANTS = {
     "full": [],
     "no_sharers_scatter": [
-        ('sharers_n = st.sharers.at[wslot_upd].set(new_row, mode="drop")',
+        ('sharers_n = st.sharers.at[upd_slot].add(delta_row, mode="drop")',
          "sharers_n = st.sharers"),
-        ('sharers_n = sharers_n.at[jslot].add(join_row, mode="drop")',
-         "sharers_n = sharers_n"),
     ],
     "no_llc_scatter": [
         ('llc_tag_n = st.llc_tag.at[wbank, bset, llc_uway].set(line, mode="drop")',
          "llc_tag_n = st.llc_tag"),
-        ('llc_lru_n = st.llc_lru.at[wbank, bset, llc_uway].set(step_no, mode="drop")',
+        ('llc_lru_n = st.llc_lru.at[lru_bank, bset, lru_way].set(step_no, mode="drop")',
          "llc_lru_n = st.llc_lru"),
         ('llc_owner_n = st.llc_owner.at[wbank, bset, llc_uway].set(new_owner, mode="drop")',
          "llc_owner_n = st.llc_owner"),
-        ("llc_lru_n = llc_lru_n.at[\n        jnp.where(join, bank, B), bset, llc_hway\n    ].max(step_no, mode=\"drop\")",
-         "llc_lru_n = llc_lru_n"),
     ],
     "no_unpack_CC": [
         ("    sh_bits = unpack_bits(shw)",
@@ -55,21 +60,37 @@ VARIANTS = {
         ('    table = table.at[jnp.where(demoted, slot, B * S2)].min(key, mode="drop")',
          "    table = table"),
     ],
-    "no_l1_selects": [
-        ("    l1_lru = jnp.where(sel_hit, step_no, st.l1_lru)",
-         "    l1_lru = st.l1_lru"),
-        ("    l1_state = jnp.where(write_hit[:, None] & hitway_sel, M, st.l1_state)",
-         "    l1_state = st.l1_state"),
-        ("    l1_tag = jnp.where(dup2, -1, l1_tag)", "    l1_tag = l1_tag"),
-        ("    l1_state = jnp.where(dup2, I, l1_state)", "    l1_state = l1_state"),
-        ("    l1_tag = jnp.where(sel_w, line[:, None], l1_tag)", "    l1_tag = l1_tag"),
-        ("    l1_state = jnp.where(sel_w, grant[:, None], l1_state)", "    l1_state = l1_state"),
-        ("    l1_lru = jnp.where(sel_w, step_no, l1_lru)", "    l1_lru = l1_lru"),
+    "no_l1_scatters": [
+        ('    l1_tag = st.l1_tag.at[dup_row, dup_col].set(-1, mode="drop")',
+         "    l1_tag = st.l1_tag"),
+        ('    l1_state = l1_state_c.at[dup_row, dup_col].set(I, mode="drop")',
+         "    l1_state = l1_state_c"),
+        ('    l1_lru = l1_lru_c.at[lru_row, lru_col].set(step_no, mode="drop")',
+         "    l1_lru = l1_lru_c"),
+        ('    l1_state = l1_state.at[st_row, st_col].set(st_val, mode="drop")',
+         "    l1_state = l1_state"),
+        ('    l1_tag = l1_tag.at[wj_row, upd_col].set(line, mode="drop")',
+         "    l1_tag = l1_tag"),
+    ],
+    "no_l1ptr_write": [
+        ('    l1_ptr = st.l1_ptr.at[wj_row, upd_col].set(fill_ptr, mode="drop")',
+         "    l1_ptr = st.l1_ptr"),
+    ],
+    "no_ptr_gathers": [
+        ("    vtag = llc_tag[pbank, pbset, pway]  # [C, W1]",
+         "    vtag = tag_rows"),
+        ("    vown = llc_owner[pbank, pbset, pway]",
+         "    vown = jnp.broadcast_to(arange_c[:, None], tag_rows.shape)"),
+        ("    vsh = sharers[pslot, pway * NW + (arange_c[:, None] >> 5)]",
+         "    vsh = jnp.zeros(tag_rows.shape, jnp.uint32)"),
     ],
     "no_phase1_validation": [
-        # effective state = local state (skip directory validation gathers)
-        ("    weff = jnp.where(\n        (state_rows == I) | ~whas,\n        I,\n        jnp.where(\n            wowner == arange_c[:, None],\n            state_rows,\n            jnp.where(wshbit, S, I),\n        ),\n    )  # [C, W1] effective MESI per way",
+        ("    weff = jnp.where(\n        (state_rows == I) | (vtag != tag_rows),\n        I,\n        jnp.where(\n            vown == arange_c[:, None],\n            state_rows,\n            jnp.where(vbit, S, I),\n        ),\n    )  # [C, W1] effective MESI per way",
          "    weff = state_rows"),
+    ],
+    "no_shrows_gather": [
+        ("    sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]",
+         "    sh_rows = jnp.zeros((C, W2, NW), jnp.uint32)"),
     ],
 }
 
@@ -98,16 +119,21 @@ def main():
     trace = fold_ins(synth.fft_like(C, n_phases=2, points_per_core=16, ins_per_mem=8, seed=42))
     events = jnp.asarray(trace.events)
     n = 256
+    base = None
     for name in VARIANTS:
         rc = build(name)
         st = init_state(cfg)
-        out = rc(cfg, n, events, st); np.asarray(out.step)
+        out = rc(cfg, n, events, st)
+        np.asarray(out.step)  # sync after warm-up/compile
         t0 = time.perf_counter()
         for _ in range(3):
             out = rc(cfg, n, events, out)
-        np.asarray(out.step)
-        dt = (time.perf_counter() - t0) / 3
-        print(f"[{name:22s}] {(dt*1e3-36)/n:.3f} ms/step (call {dt*1e3:.0f}ms)", flush=True)
+        np.asarray(out.step)  # sync
+        dt = (time.perf_counter() - t0) / 3 / n
+        if name == "full":
+            base = dt
+        delta = "" if base is None else f"  (saves {1e3*(base-dt):+.3f})"
+        print(f"[{name:22s}] {dt*1e3:.3f} ms/step{delta}", flush=True)
 
 
 if __name__ == "__main__":
