@@ -112,6 +112,12 @@ class MachineConfig:
     # boundary for non-miss work (SURVEY.md §3.2): private hits shouldn't
     # cost a simulation step.
     local_run_len: int = 0
+    # Synchronization modeling (DESIGN.md §3-sync; the reference intercepts
+    # pthread mutex/barrier calls, SURVEY.md §2 #1). Mutex addresses hash
+    # into `lock_slots` table entries (collisions = conservative false
+    # contention); barrier ids must be dense ints < `barrier_slots`.
+    lock_slots: int = 1024
+    barrier_slots: int = 64
 
     def __post_init__(self):
         self.validate()
@@ -136,6 +142,10 @@ class MachineConfig:
             raise ValueError("mesh dims must be >= 1")
         if not (0 <= self.local_run_len <= 64):
             raise ValueError("local_run_len must be in [0, 64]")
+        if not _is_pow2(self.lock_slots):
+            raise ValueError("lock_slots must be a power of two")
+        if not _is_pow2(self.barrier_slots):
+            raise ValueError("barrier_slots must be a power of two")
 
     # Derived geometry used by both engines --------------------------------
 

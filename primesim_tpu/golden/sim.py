@@ -19,7 +19,16 @@ import numpy as np
 from ..config.machine import MachineConfig
 from ..noc.mesh import bank_tile, core_tile, hops as _hops, one_way_lat
 from ..stats.counters import zero_counters
-from ..trace.format import EV_END, EV_INS, EV_LD, EV_ST, Trace
+from ..trace.format import (
+    EV_BARRIER,
+    EV_END,
+    EV_INS,
+    EV_LD,
+    EV_LOCK,
+    EV_ST,
+    EV_UNLOCK,
+    Trace,
+)
 
 # MESI encoding shared with the JAX engine
 I, S, E, M = 0, 1, 2, 3
@@ -52,6 +61,17 @@ class GoldenSim:
         self.quantum_end = cfg.quantum
         self.step_count = 0
 
+        # synchronization state (DESIGN.md §3 phase 2.7)
+        self.lock_holder = np.full(cfg.lock_slots, -1, dtype=np.int64)
+        self.barrier_count = np.zeros(cfg.barrier_slots, dtype=np.int64)
+        self.barrier_time = np.zeros(cfg.barrier_slots, dtype=np.int64)
+        self.sync_flag = np.zeros(C, dtype=np.int64)
+        if (trace.events[:, :, 2][trace.events[:, :, 0] == EV_BARRIER]
+                >= cfg.barrier_slots).any():
+            raise ValueError(
+                f"trace uses barrier ids >= barrier_slots={cfg.barrier_slots}"
+            )
+
     # ------------------------------------------------------------ helpers
 
     def _line(self, addr: int) -> int:
@@ -81,6 +101,12 @@ class GoldenSim:
     def _clear_sharers(self, b, s, w):
         self.sharers[b, s, w, :] = 0
 
+    def _lock_slot(self, addr: int) -> int:
+        return (addr >> self.cfg.line_bits) & (self.cfg.lock_slots - 1)
+
+    def _lock_home_tile(self, addr: int) -> int:
+        return bank_tile(self._bank(self._line(addr)), self.cfg)
+
     def _noc(self, c: int, tile_a: int, tile_b: int):
         """Charge one message tile_a->tile_b to core c's NoC counters."""
         lat = one_way_lat(tile_a, tile_b, self.cfg)
@@ -102,16 +128,22 @@ class GoldenSim:
         C = cfg.n_cores
         ev = self.trace.events
 
-        # --- quantum barrier (DESIGN.md §3): bump quantum_end if nobody active
+        # --- quantum barrier (DESIGN.md §3): bump quantum_end if nobody
+        # active. Barrier-frozen cores neither bump nor bound the quantum.
         cur = [ev[c, min(int(self.ptr[c]), self.trace.max_len - 1)] for c in range(C)]
         not_done = [c for c in range(C) if cur[c][0] != EV_END]
         if not not_done:
             return
-        active = [c for c in not_done if self.cycles[c] < self.quantum_end]
-        if not active:
-            m = min(int(self.cycles[c]) for c in not_done)
+
+        def _frozen(c):
+            return cur[c][0] == EV_BARRIER and self.sync_flag[c]
+
+        countable = [c for c in not_done if not _frozen(c)]
+        active = [c for c in countable if self.cycles[c] < self.quantum_end]
+        if not active and countable:
+            m = min(int(self.cycles[c]) for c in countable)
             self.quantum_end = (m // cfg.quantum + 1) * cfg.quantum
-            active = [c for c in not_done if self.cycles[c] < self.quantum_end]
+            active = [c for c in countable if self.cycles[c] < self.quantum_end]
 
         step = self.step_count
         self.step_count += 1
@@ -138,6 +170,8 @@ class GoldenSim:
                     self.counters["instructions"][c] += arg
                     self.ptr[c] += 1
                     continue
+                if t not in (EV_LD, EV_ST):
+                    break  # sync events are never local: arbitrate below
                 line = self._line(addr)
                 s = self._l1_set(line)
                 w = -1
@@ -170,7 +204,9 @@ class GoldenSim:
             active = [
                 c
                 for c in range(C)
-                if cur[c][0] != EV_END and self.cycles[c] < self.quantum_end
+                if cur[c][0] != EV_END
+                and not _frozen(c)
+                and self.cycles[c] < self.quantum_end
             ]
 
         # --- phase 0/1: classify against step-start state ------------------
@@ -186,6 +222,9 @@ class GoldenSim:
         # request tuple: (cycles, core, kind, line, pre)
         requests = []
         joins = []  # read-join candidates: (core, line, pre)
+        lock_reqs = []  # (cycles, core, addr, pre)
+        unlocks = []  # (core, addr, pre)
+        barrier_arr = []  # (core, barrier id, n participants, pre)
         GETS, GETM, UPG = 0, 1, 2
 
         for c in active:
@@ -195,6 +234,15 @@ class GoldenSim:
                 self.cycles[c] += arg * int(self.cpi[c])
                 self.counters["instructions"][c] += arg
                 self.ptr[c] += 1
+                continue
+            if t == EV_LOCK:
+                lock_reqs.append((int(self.cycles[c]), c, addr, pre))
+                continue
+            if t == EV_UNLOCK:
+                unlocks.append((c, addr, pre))
+                continue
+            if t == EV_BARRIER:
+                barrier_arr.append((c, addr, arg, pre))
                 continue
             line = self._line(addr)
             s = self._l1_set(line)
@@ -424,6 +472,87 @@ class GoldenSim:
                     else:
                         self.l1_state[tcore, s, wy] = I
                     break
+
+        # --- phase 2.7: synchronization events (DESIGN.md) -----------------
+        # Sync and memory phases touch disjoint per-core/table state, so
+        # their relative order within the step is immaterial; unlocks ->
+        # lock grants -> barrier arrivals -> releases is the canonical
+        # order WITHIN sync.
+        for c, addr, pre in unlocks:
+            s = self._lock_slot(addr)
+            h = self._lock_home_tile(addr)
+            ctile = core_tile(c, cfg)
+            lat = self._noc(c, ctile, h) + cfg.llc.latency + self._noc(c, h, ctile)
+            self.cycles[c] += pre * int(self.cpi[c]) + lat
+            self.counters["instructions"][c] += pre + 1
+            if self.lock_holder[s] == c:
+                self.lock_holder[s] = -1
+            self.ptr[c] += 1
+
+        by_slot: dict[int, list] = {}
+        for r in lock_reqs:
+            by_slot.setdefault(self._lock_slot(r[2]), []).append(r)
+        for s, rs in sorted(by_slot.items()):
+            rs.sort(key=lambda r: (r[0], r[1]))  # (cycles, core_id)
+            for i, (cyc, c, addr, pre) in enumerate(rs):
+                h = self._lock_home_tile(addr)
+                ctile = core_tile(c, cfg)
+                # every attempt (grant or spin) is a charged RMW round trip
+                lat = (
+                    self._noc(c, ctile, h)
+                    + cfg.llc.latency
+                    + self._noc(c, h, ctile)
+                )
+                if self.sync_flag[c] == 0:  # first attempt: charge pre batch
+                    self.cycles[c] += pre * int(self.cpi[c])
+                    self.counters["instructions"][c] += pre
+                self.cycles[c] += lat
+                holder = int(self.lock_holder[s])
+                if holder == c or (i == 0 and holder == -1):
+                    self.lock_holder[s] = c
+                    self.counters["lock_acquires"][c] += 1
+                    self.counters["instructions"][c] += 1
+                    self.sync_flag[c] = 0
+                    self.ptr[c] += 1
+                else:
+                    self.counters["lock_spins"][c] += 1
+                    self.sync_flag[c] = 1
+
+        for c, bid, n, pre in barrier_arr:
+            h = bid % cfg.n_tiles
+            ctile = core_tile(c, cfg)
+            self.cycles[c] += pre * int(self.cpi[c])
+            self.counters["instructions"][c] += pre
+            self.cycles[c] += self._noc(c, ctile, h)  # arrival message
+            self.counters["barrier_waits"][c] += 1
+            self.sync_flag[c] = 1
+            self.barrier_count[bid] += 1
+            self.barrier_time[bid] = max(
+                int(self.barrier_time[bid]), int(self.cycles[c])
+            )
+
+        # releases: every waiter whose slot count reached ITS participant
+        # count resumes at the slot's max arrival time + wake-up message
+        waiting: dict[int, list] = {}
+        for c in range(C):
+            e = ev[c, min(int(self.ptr[c]), self.trace.max_len - 1)]
+            if int(e[0]) == EV_BARRIER and self.sync_flag[c]:
+                waiting.setdefault(int(e[2]), []).append((c, int(e[1])))
+        for bid, ws in sorted(waiting.items()):
+            rel = [c for c, n in ws if self.barrier_count[bid] >= n]
+            for c in rel:
+                h = bid % cfg.n_tiles
+                ctile = core_tile(c, cfg)
+                self.cycles[c] = int(self.barrier_time[bid]) + self._noc(
+                    c, h, ctile
+                )
+                self.counters["instructions"][c] += 1
+                self.sync_flag[c] = 0
+                self.ptr[c] += 1
+            self.barrier_count[bid] -= len(rel)
+            if self.barrier_count[bid] <= 0:
+                self.barrier_count[bid] = 0
+                self.barrier_time[bid] = 0
 
     # ------------------------------------------------------ read-join path
 

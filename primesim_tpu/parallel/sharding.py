@@ -63,6 +63,12 @@ def state_pspecs() -> MachineState:
         llc_owner=P(AXIS),
         llc_lru=P(AXIS),
         sharers=P(AXIS),
+        # lock/barrier tables are small and written from arbitrary cores'
+        # lanes — replicate them (XLA reduces the scatters across devices)
+        lock_holder=P(),
+        barrier_count=P(),
+        barrier_time=P(),
+        sync_flag=P(AXIS),
         quantum_end=P(),
         step=P(),
         counters=P(None, AXIS),

@@ -36,7 +36,16 @@ import numpy as np
 
 from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES, zero_counters
-from ..trace.format import EV_END, EV_INS, EV_LD, EV_ST, Trace
+from ..trace.format import (
+    EV_BARRIER,
+    EV_END,
+    EV_INS,
+    EV_LD,
+    EV_LOCK,
+    EV_ST,
+    EV_UNLOCK,
+    Trace,
+)
 from .state import E, I, M, MachineState, S, init_state
 
 INT32_MAX = np.int32(2**31 - 1)
@@ -174,14 +183,16 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         return cnt.at[_CIDX[name]].add(amount.astype(jnp.int32))
 
     # ---- phase 0: quantum barrier (on step-entry state) ------------------
+    # Barrier-frozen cores (arrived, waiting for release) neither bump nor
+    # bound the quantum (DESIGN.md §3): they rejoin at release.
     p0 = jnp.minimum(st.ptr, T - 1)
     et0 = events[arange_c, p0, 0]
-    not_done0 = et0 != EV_END
-    any_not_done = jnp.any(not_done0)
-    any_active = jnp.any(not_done0 & (st.cycles < st.quantum_end))
-    min_nd = jnp.min(jnp.where(not_done0, st.cycles, INT32_MAX))
+    countable0 = (et0 != EV_END) & ~((et0 == EV_BARRIER) & (st.sync_flag != 0))
+    any_countable = jnp.any(countable0)
+    any_active = jnp.any(countable0 & (st.cycles < st.quantum_end))
+    min_nd = jnp.min(jnp.where(countable0, st.cycles, INT32_MAX))
     bumped = (min_nd // Q + 1) * Q
-    quantum_end = jnp.where(any_not_done & ~any_active, bumped, st.quantum_end)
+    quantum_end = jnp.where(any_countable & ~any_active, bumped, st.quantum_end)
 
     step_no = st.step
 
@@ -238,11 +249,15 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     ev = events[arange_c, p]  # [C, 4]
     et, earg, eaddr, epre = ev[:, 0], ev[:, 1], ev[:, 2], ev[:, 3]
     not_done = et != EV_END
-    active = not_done & (cycles_c < quantum_end)
+    frozen = (et == EV_BARRIER) & (st.sync_flag != 0)
+    active = not_done & ~frozen & (cycles_c < quantum_end)
 
     is_ins = active & (et == EV_INS)
     is_st_ev = et == EV_ST
     is_mem = active & ((et == EV_LD) | is_st_ev)
+    is_lock = active & (et == EV_LOCK)
+    is_unlock = active & (et == EV_UNLOCK)
+    is_barrier = active & (et == EV_BARRIER)  # arrivals (frozen excluded)
 
     # ---- phase 1: L1 lookup + classification (post-run state) ------------
     line = eaddr >> cfg.line_bits  # [C] int32 (addresses < 2^31)

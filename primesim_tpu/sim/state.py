@@ -53,6 +53,11 @@ class MachineState(NamedTuple):
     # costing two full copies per step. (At the 1024-core flagship config
     # the minor dim is also a 128 multiple, which tiles without padding.)
     sharers: jnp.ndarray  # [B*S2, W2*NW] uint32 packed sharer bits
+    # synchronization state (DESIGN.md §3 phase 2.7)
+    lock_holder: jnp.ndarray  # [lock_slots] int32 core id or -1
+    barrier_count: jnp.ndarray  # [barrier_slots] int32 arrivals this round
+    barrier_time: jnp.ndarray  # [barrier_slots] int32 max arrival clock (epoch-relative)
+    sync_flag: jnp.ndarray  # [C] int32 1 = pre charged / arrived at event at ptr
     # global clocks
     quantum_end: jnp.ndarray  # [] int32
     step: jnp.ndarray  # [] int32
@@ -81,6 +86,10 @@ def init_state(cfg: MachineConfig) -> MachineState:
         llc_owner=jnp.full((B, s2, w2), -1, jnp.int32),
         llc_lru=jnp.zeros((B, s2, w2), jnp.int32),
         sharers=jnp.zeros((B * s2, w2 * nw), jnp.uint32),
+        lock_holder=jnp.full(cfg.lock_slots, -1, jnp.int32),
+        barrier_count=jnp.zeros(cfg.barrier_slots, jnp.int32),
+        barrier_time=jnp.zeros(cfg.barrier_slots, jnp.int32),
+        sync_flag=jnp.zeros(C, jnp.int32),
         quantum_end=jnp.asarray(cfg.quantum, jnp.int32),
         step=jnp.asarray(0, jnp.int32),
         counters=jnp.zeros((len(COUNTER_NAMES), C), jnp.int32),

@@ -30,6 +30,9 @@ COUNTER_NAMES = (
     "noc_msgs",
     "noc_hops",
     "retries",         # conflict-serialization retries (lost (bank,set) race)
+    "lock_acquires",   # LOCK events retired
+    "lock_spins",      # failed LOCK attempts (charged spin round trips)
+    "barrier_waits",   # BARRIER arrivals
 )
 
 

@@ -17,13 +17,20 @@ the engine's unit of wall-clock cost.
 
 Binary file layout (little-endian):
     magic   uint32  0x50545055  ("PTPU")
-    version uint32  2   (v1 files with 3-field records load fine, pre=0)
+    version uint32  3   (v1: 3-field records, pre=0; v2: no sync events)
     n_cores uint32
     max_len uint32  (padded per-core event count)
     lengths uint32[n_cores]  (true event count per core, <= max_len)
     events  int32[n_cores, max_len, 4]   (type, arg, addr, pre)
 
 Cores with fewer than max_len events are padded with END events.
+
+v3 adds the inter-thread synchronization events the reference's Pin
+frontend captures by intercepting pthread_mutex/barrier calls (SURVEY.md
+§2 #1, §3.5): LOCK/UNLOCK carry the mutex's byte address (hashed to a
+lock-table slot by the engines), BARRIER carries a dense barrier id in
+`addr` and the participant count in `arg`. All three use `pre` like
+memory events. Timing/blocking semantics are DESIGN.md §3-sync.
 """
 
 from __future__ import annotations
@@ -31,15 +38,19 @@ from __future__ import annotations
 import numpy as np
 
 MAGIC = 0x50545055
-VERSION = 2
+VERSION = 3
 
 # Event types (DESIGN.md §2)
 EV_INS = 0  # batch of non-memory instructions; arg = count
 EV_LD = 1  # load;  addr = byte address (31-bit in v1), arg = size
 EV_ST = 2  # store; addr = byte address (31-bit in v1), arg = size
 EV_END = 3  # core finished
+EV_LOCK = 4  # acquire mutex; addr = mutex byte address
+EV_UNLOCK = 5  # release mutex; addr = mutex byte address
+EV_BARRIER = 6  # barrier wait; addr = barrier id, arg = participant count
 
 N_FIELDS = 4  # (type, arg, addr, pre)
+SYNC_TYPES = (EV_LOCK, EV_UNLOCK, EV_BARRIER)
 
 
 class Trace:
@@ -53,14 +64,19 @@ class Trace:
         assert lengths.shape == (events.shape[0],)
         t = events[:, :, 0]
         if t.size:
-            if not ((t >= EV_INS) & (t <= EV_END)).all():
+            if not ((t >= EV_INS) & (t <= EV_BARRIER)).all():
                 raise ValueError("trace contains invalid event types")
-            mem = (t == EV_LD) | (t == EV_ST)
+            mem = (t == EV_LD) | (t == EV_ST) | (t == EV_LOCK) | (t == EV_UNLOCK)
             if (events[:, :, 2][mem] < 0).any():
                 raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
             if (events[:, :, 1][t == EV_INS] < 0).any():
                 raise ValueError("INS batch counts must be >= 0")
-            if (events[:, :, 3][mem] < 0).any():
+            bar = t == EV_BARRIER
+            if (events[:, :, 2][bar] < 0).any():
+                raise ValueError("barrier ids must be >= 0")
+            if (events[:, :, 1][bar] < 1).any():
+                raise ValueError("barrier participant counts must be >= 1")
+            if (events[:, :, 3][mem | bar] < 0).any():
                 raise ValueError("pre-batched instruction counts must be >= 0")
             if (lengths > events.shape[1]).any() or (lengths < 1).any():
                 raise ValueError("per-core lengths out of range")
@@ -81,12 +97,12 @@ class Trace:
         return self.events.shape[1]
 
     def total_instructions(self) -> int:
-        """Total simulated instructions (INS + pre-batched + 1 per mem op)."""
+        """Total simulated instructions (INS + pre-batched + 1 per mem/sync op)."""
         t = self.events[:, :, 0]
         ins = np.where(t == EV_INS, self.events[:, :, 1], 0).astype(np.int64).sum()
-        mem_mask = (t == EV_LD) | (t == EV_ST)
-        pre = np.where(mem_mask, self.events[:, :, 3], 0).astype(np.int64).sum()
-        return int(ins) + int(pre) + int(mem_mask.sum())
+        op_mask = (t != EV_INS) & (t != EV_END)  # mem + sync events
+        pre = np.where(op_mask, self.events[:, :, 3], 0).astype(np.int64).sum()
+        return int(ins) + int(pre) + int(op_mask.sum())
 
     # ---------------------------------------------------------------- I/O
 
@@ -103,7 +119,7 @@ class Trace:
             hdr = np.fromfile(f, dtype="<u4", count=4)
             if hdr.shape[0] != 4 or hdr[0] != MAGIC:
                 raise ValueError(f"{path}: not a primesim_tpu trace file")
-            if hdr[1] not in (1, 2):
+            if hdr[1] not in (1, 2, 3):
                 raise ValueError(f"{path}: unsupported trace version {hdr[1]}")
             nf = 3 if hdr[1] == 1 else N_FIELDS
             n_cores, max_len = int(hdr[2]), int(hdr[3])
@@ -148,13 +164,13 @@ def from_event_lists(per_core: list[list[tuple]]) -> Trace:
 
 
 def fold_ins(trace: Trace) -> Trace:
-    """Fold INS batches into the following memory event's `pre` field.
+    """Fold INS batches into the following memory/sync event's `pre` field.
 
     The folded trace is the same workload expressed in PriME's per-BBL
     batched form (SURVEY.md §3.2): each batch of non-memory instructions
-    retires in the same simulation step as the memory access that follows
-    it. INS batches not followed by a memory event (trailing work before
-    END) are kept as explicit INS events.
+    retires in the same simulation step as the memory/sync operation that
+    follows it. INS batches not followed by one (trailing work before END)
+    are kept as explicit INS events.
     """
     out: list[list[tuple]] = []
     for c in range(trace.n_cores):
@@ -164,7 +180,7 @@ def fold_ins(trace: Trace) -> Trace:
             t, arg, addr, pre = (int(x) for x in trace.events[c, i])
             if t == EV_INS:
                 acc += arg
-            elif t in (EV_LD, EV_ST):
+            elif t != EV_END:
                 evs.append((t, arg, addr, pre + acc))
                 acc = 0
             else:  # END
