@@ -592,6 +592,10 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         llc_owner=llc_owner_n,
         llc_lru=llc_lru_n,
         sharers=sharers_n,
+        lock_holder=st.lock_holder,
+        barrier_count=st.barrier_count,
+        barrier_time=st.barrier_time,
+        sync_flag=st.sync_flag,
         quantum_end=quantum_end,
         step=step_no + 1,
         counters=cnt,
