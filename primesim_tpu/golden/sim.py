@@ -66,11 +66,9 @@ class GoldenSim:
         self.barrier_count = np.zeros(cfg.barrier_slots, dtype=np.int64)
         self.barrier_time = np.zeros(cfg.barrier_slots, dtype=np.int64)
         self.sync_flag = np.zeros(C, dtype=np.int64)
-        if (trace.events[:, :, 2][trace.events[:, :, 0] == EV_BARRIER]
-                >= cfg.barrier_slots).any():
-            raise ValueError(
-                f"trace uses barrier ids >= barrier_slots={cfg.barrier_slots}"
-            )
+        from ..trace.format import validate_sync
+
+        validate_sync(trace, cfg.barrier_slots)
 
     # ------------------------------------------------------------ helpers
 
@@ -144,6 +142,13 @@ class GoldenSim:
             m = min(int(self.cycles[c]) for c in countable)
             self.quantum_end = (m // cfg.quantum + 1) * cfg.quantum
             active = [c for c in countable if self.cycles[c] < self.quantum_end]
+        # Clock-window invariant (DESIGN.md §3-sync): every active core's
+        # clock lies in [quantum_end - Q, quantum_end). The JAX engine's
+        # packed arbitration keys (rel*C + core) REQUIRE this; asserting it
+        # here makes every golden/parity test also an invariant check.
+        assert all(
+            self.cycles[c] >= self.quantum_end - cfg.quantum for c in active
+        ), "clock-window invariant violated"
 
         step = self.step_count
         self.step_count += 1

@@ -166,7 +166,12 @@ def _l1_probe_hit(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
     return eff != I, eff, hit_col
 
 
-def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineState:
+def step(
+    cfg: MachineConfig,
+    events: jnp.ndarray,
+    st: MachineState,
+    has_sync: bool = True,
+) -> MachineState:
     C = cfg.n_cores
     B = cfg.n_banks
     S1, W1 = cfg.l1.sets, cfg.l1.ways
@@ -314,6 +319,13 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # targets its home (bank,set) this step; else it demotes to normal GETS.
     join_elig = gets & llc_has & (owner == -1) & other_sharers
     req = (gets & ~join_elig) | getm | upg
+    # Packed single-scatter key ordering by (cycles, core_id). Valid because
+    # every arbitrating lane's clock lies in [quantum_end - Q, quantum_end):
+    # clocks never decrease, quantum bumps stop at min_countable + Q, and a
+    # barrier release resumes waiters at the slot's max ARRIVAL clock — set
+    # in the same step as the count-completing arrival, whose core was
+    # active then — so released clocks re-enter the window too (DESIGN.md
+    # §3-sync invariant; the golden model asserts it every step).
     rel = cycles_c - (quantum_end - Q)  # in [0, Q) for active requesters
     key = rel * C + arange_c  # orders by (cycles, core_id); < Q*C < 2^31
     table = jnp.full(B * S2, INT32_MAX, jnp.int32)
@@ -581,6 +593,117 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
     # ARE the invalidations/downgrades — remote L1s re-derive their state on
     # their next access (phase 1 validation).
 
+    # ---- phase 2.7: synchronization events (golden/sim.py phase 2.7) -----
+    # Sync lanes (LOCK/UNLOCK/BARRIER) are disjoint from every memory lane
+    # above (classification is by event type), so ordering after phase 4.A
+    # is immaterial; WITHIN sync the canonical order is unlocks -> lock
+    # grants -> barrier arrivals -> releases. `has_sync` is static: traces
+    # without sync events (checked at ingest) skip this block entirely.
+    lock_holder = st.lock_holder
+    barrier_count = st.barrier_count
+    barrier_time = st.barrier_time
+    sync_flag = st.sync_flag
+    if has_sync:
+        L = cfg.lock_slots
+        BS = cfg.barrier_slots
+        # mutex address -> lock slot; its home is the line's home bank, so
+        # the phase-3 core<->home-bank latencies/hops apply verbatim
+        lslot = line & (L - 1)
+        lreq_lat, lreq_hops = req_lat, req_hops
+        lrep_lat, lrep_hops = rep_lat, rep_hops
+        lat_rt = lreq_lat + cfg.llc.latency + lrep_lat
+
+        # unlocks: every unlock is a charged RMW round trip to the lock's
+        # home; the slot is released only if this core actually holds it
+        cycles = cycles + jnp.where(is_unlock, epre * cpi_vec + lat_rt, 0)
+        ptr = ptr + is_unlock.astype(jnp.int32)
+        cnt = cadd(cnt, "instructions", jnp.where(is_unlock, epre + 1, 0))
+        cnt = cadd(cnt, "noc_msgs", jnp.where(is_unlock, 2, 0))
+        cnt = cadd(cnt, "noc_hops", jnp.where(is_unlock, lreq_hops + lrep_hops, 0))
+        held = lock_holder[lslot] == arange_c
+        lock_holder = lock_holder.at[
+            jnp.where(is_unlock & held, lslot, L)
+        ].set(-1, mode="drop")
+
+        # lock grants: per-slot scatter-min arbitration on (cycles, core_id)
+        # — the golden sort order, same key packing as the (bank,set) table
+        # above (the same clock-window invariant covers it). Grant iff the
+        # slot is free AFTER unlocks and this core holds the minimum key,
+        # OR the core already holds the lock (re-acquire). At most one
+        # grant per slot: free excludes re-acquire.
+        rel_l = cycles_c - (quantum_end - Q)
+        lkey = rel_l * C + arange_c
+        ltable = jnp.full(L, INT32_MAX, jnp.int32)
+        ltable = ltable.at[jnp.where(is_lock, lslot, L)].min(lkey, mode="drop")
+        lwin = is_lock & (ltable[lslot] == lkey)
+        holder1 = lock_holder[lslot]
+        grant = is_lock & ((holder1 == arange_c) | ((holder1 == -1) & lwin))
+        spin = is_lock & ~grant
+        # every attempt (grant or spin) is a charged round trip; the pre
+        # batch is charged only on the FIRST attempt (sync_flag still 0)
+        first = is_lock & (st.sync_flag == 0)
+        cycles = (
+            cycles
+            + jnp.where(first, epre * cpi_vec, 0)
+            + jnp.where(is_lock, lat_rt, 0)
+        )
+        cnt = cadd(
+            cnt,
+            "instructions",
+            jnp.where(first, epre, 0) + grant.astype(jnp.int32),
+        )
+        cnt = cadd(cnt, "lock_acquires", grant)
+        cnt = cadd(cnt, "lock_spins", spin)
+        cnt = cadd(cnt, "noc_msgs", jnp.where(is_lock, 2, 0))
+        cnt = cadd(cnt, "noc_hops", jnp.where(is_lock, lreq_hops + lrep_hops, 0))
+        lock_holder = lock_holder.at[jnp.where(grant, lslot, L)].set(
+            arange_c, mode="drop"
+        )
+        sync_flag = jnp.where(grant, 0, jnp.where(spin, 1, sync_flag))
+        ptr = ptr + grant.astype(jnp.int32)
+
+        # barrier arrivals: charge pre + the arrival message, freeze the
+        # core, bump the slot's count and max-arrival clock
+        bid = jnp.where(et == EV_BARRIER, eaddr, 0)  # ids validated < BS
+        htile = bid % n_tiles
+        barr_lat, barr_hops = _one_way(ctile, htile, cfg)
+        wake_lat, wake_hops = _one_way(htile, ctile, cfg)
+        cycles = cycles + jnp.where(is_barrier, epre * cpi_vec + barr_lat, 0)
+        cnt = cadd(cnt, "instructions", jnp.where(is_barrier, epre, 0))
+        cnt = cadd(cnt, "barrier_waits", is_barrier)
+        cnt = cadd(cnt, "noc_msgs", is_barrier)
+        cnt = cadd(cnt, "noc_hops", jnp.where(is_barrier, barr_hops, 0))
+        sync_flag = jnp.where(is_barrier, 1, sync_flag)
+        barrier_count = barrier_count.at[
+            jnp.where(is_barrier, bid, BS)
+        ].add(1, mode="drop")
+        barrier_time = barrier_time.at[
+            jnp.where(is_barrier, bid, BS)
+        ].max(cycles, mode="drop")
+
+        # releases: every waiter (frozen earlier or arrived this step) whose
+        # slot count reached ITS participant count resumes at the slot's
+        # max arrival clock + wake-up message. Waiters' ptr/event are
+        # unchanged this step (frozen lanes retire nothing), so the phase-0.9
+        # gather is still current for them.
+        wait_m = (et == EV_BARRIER) & (sync_flag == 1)
+        released = wait_m & (barrier_count[bid] >= earg)
+        cycles = jnp.where(released, barrier_time[bid] + wake_lat, cycles)
+        cnt = cadd(cnt, "instructions", released)
+        cnt = cadd(cnt, "noc_msgs", released)
+        cnt = cadd(cnt, "noc_hops", jnp.where(released, wake_hops, 0))
+        sync_flag = jnp.where(released, 0, sync_flag)
+        ptr = ptr + released.astype(jnp.int32)
+        nrel = (
+            jnp.zeros(BS, jnp.int32)
+            .at[jnp.where(released, bid, BS)]
+            .add(1, mode="drop")
+        )
+        barrier_count = barrier_count - nrel
+        drained = barrier_count <= 0
+        barrier_count = jnp.where(drained, 0, barrier_count)
+        barrier_time = jnp.where(drained, 0, barrier_time)
+
     return MachineState(
         cycles=cycles,
         ptr=ptr,
@@ -592,22 +715,27 @@ def step(cfg: MachineConfig, events: jnp.ndarray, st: MachineState) -> MachineSt
         llc_owner=llc_owner_n,
         llc_lru=llc_lru_n,
         sharers=sharers_n,
-        lock_holder=st.lock_holder,
-        barrier_count=st.barrier_count,
-        barrier_time=st.barrier_time,
-        sync_flag=st.sync_flag,
+        lock_holder=lock_holder,
+        barrier_count=barrier_count,
+        barrier_time=barrier_time,
+        sync_flag=sync_flag,
         quantum_end=quantum_end,
         step=step_no + 1,
         counters=cnt,
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def run_chunk(cfg: MachineConfig, n_steps: int, events, st: MachineState):
+@functools.partial(
+    jax.jit, static_argnums=(0, 1), static_argnames=("has_sync",)
+)
+def run_chunk(
+    cfg: MachineConfig, n_steps: int, events, st: MachineState,
+    has_sync: bool = True,
+):
     """lax.scan over `n_steps` steps — the jitted hot loop."""
 
     def body(carry, _):
-        return step(cfg, events, carry), None
+        return step(cfg, events, carry, has_sync=has_sync), None
 
     st, _ = jax.lax.scan(body, st, None, length=n_steps)
     return st
@@ -619,9 +747,11 @@ def _device_done(events, st, arange_c):
     return jnp.all(events[arange_c, p, 0] == EV_END)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
+@functools.partial(
+    jax.jit, static_argnums=(0, 1), static_argnames=("has_sync",)
+)
 def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
-             max_chunks):
+             max_chunks, has_sync: bool = True):
     """ONE dispatched device program for a whole simulation run.
 
     `lax.while_loop` over scan chunks; after each chunk, ON DEVICE: drain
@@ -645,7 +775,7 @@ def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
         st, acc_lo, acc_hi, base_lo, base_hi, k = carry
 
         def sbody(c, _):
-            return step(cfg, events, c), None
+            return step(cfg, events, c, has_sync=has_sync), None
 
         st, _ = jax.lax.scan(sbody, st, None, length=chunk_steps)
         # drain counters (lo/hi pair; both stay < 2^31)
@@ -653,13 +783,21 @@ def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
         acc_hi = acc_hi + (acc_lo >> _ACC_BITS)
         acc_lo = acc_lo & ((1 << _ACC_BITS) - 1)
         st = st._replace(counters=jnp.zeros_like(st.counters))
-        # rebase clocks by a whole number of quanta
+        # rebase clocks by a whole number of quanta. barrier_time entries of
+        # OCCUPIED slots are epoch-relative max-arrival clocks, so they
+        # rebase with the core clocks (delta <= every frozen waiter's
+        # arrival clock <= the slot max, so they stay non-negative);
+        # unoccupied slots hold the reset value 0 and must stay 0.
         p = jnp.minimum(st.ptr, T - 1)
         nd = events[arange_c, p, 0] != EV_END
         m = jnp.min(jnp.where(nd, st.cycles, INT32_MAX))
         delta = jnp.where(jnp.any(nd), (m // Q) * Q, 0)
         st = st._replace(
-            cycles=st.cycles - delta, quantum_end=st.quantum_end - delta
+            cycles=st.cycles - delta,
+            quantum_end=st.quantum_end - delta,
+            barrier_time=jnp.where(
+                st.barrier_count > 0, st.barrier_time - delta, st.barrier_time
+            ),
         )
         base_lo = base_lo + delta
         base_hi = base_hi + (base_lo >> _ACC_BITS)
@@ -697,6 +835,14 @@ class Engine:
         assert trace.n_cores == cfg.n_cores
         self.cfg = cfg
         self.trace = trace
+        # static specialization: traces without sync events skip phase 2.7
+        from ..trace.format import validate_sync
+
+        validate_sync(trace, cfg.barrier_slots)
+        t = trace.events[:, :, 0]
+        self.has_sync = bool(
+            ((t == EV_LOCK) | (t == EV_UNLOCK) | (t == EV_BARRIER)).any()
+        )
         self.events = jnp.asarray(trace.events)
         self.state = init_state(cfg)
         self.mesh = mesh
@@ -735,6 +881,12 @@ class Engine:
         self.state = self.state._replace(
             cycles=self.state.cycles - np.int32(delta),
             quantum_end=self.state.quantum_end - np.int32(delta),
+            # occupied barrier slots hold epoch-relative arrival clocks
+            barrier_time=jnp.where(
+                self.state.barrier_count > 0,
+                self.state.barrier_time - np.int32(delta),
+                self.state.barrier_time,
+            ),
         )
 
     def done(self) -> bool:
@@ -749,6 +901,7 @@ class Engine:
             self.events,
             self.state,
             jnp.asarray(max_chunks, jnp.int32),
+            has_sync=self.has_sync,
         )
         # one synchronizing transfer for everything the host needs
         acc_lo = np.asarray(acc_lo).astype(np.int64)
@@ -772,7 +925,10 @@ class Engine:
         loop's on-device bookkeeping.
         """
         while self.steps_run < max_steps and not self.done():
-            self.state = run_chunk(self.cfg, self.chunk_steps, self.events, self.state)
+            self.state = run_chunk(
+                self.cfg, self.chunk_steps, self.events, self.state,
+                has_sync=self.has_sync,
+            )
             self.steps_run += self.chunk_steps
             self._drain()
             self._rebase()

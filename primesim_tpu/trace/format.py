@@ -135,6 +135,19 @@ class Trace:
         return Trace(events, lengths)
 
 
+def validate_sync(trace: Trace, barrier_slots: int) -> None:
+    """Reject traces whose barrier ids exceed a machine's slot table.
+
+    Shared by both engines (golden + JAX) so they accept exactly the same
+    traces; barrier ids are dense ints < barrier_slots by contract.
+    """
+    t = trace.events[:, :, 0]
+    if (trace.events[:, :, 2][t == EV_BARRIER] >= barrier_slots).any():
+        raise ValueError(
+            f"trace uses barrier ids >= barrier_slots={barrier_slots}"
+        )
+
+
 def from_event_lists(per_core: list[list[tuple]]) -> Trace:
     """Build a padded Trace from python per-core event lists.
 

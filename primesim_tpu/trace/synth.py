@@ -14,6 +14,10 @@ expected statistics are analyzable:
                         with partner cores (SPLASH-2 FFT communication shape)
 - ``readers_writer``  — one producer writes a block, all others read it
                         (invalidation broadcast shape)
+- ``lock_contention`` — cores hammer a small set of mutexes around short
+                        critical sections (pthread_mutex shape; LOCK/UNLOCK)
+- ``barrier_phases``  — bulk-synchronous phases of private work separated
+                        by global (or subset) barriers (SPLASH-2 phase shape)
 
 All generators are deterministic given ``seed``.
 """
@@ -22,7 +26,16 @@ from __future__ import annotations
 
 import numpy as np
 
-from .format import EV_INS, EV_LD, EV_ST, Trace, from_event_lists
+from .format import (
+    EV_BARRIER,
+    EV_INS,
+    EV_LD,
+    EV_LOCK,
+    EV_ST,
+    EV_UNLOCK,
+    Trace,
+    from_event_lists,
+)
 
 
 def _rng(seed: int) -> np.random.Generator:
@@ -191,6 +204,67 @@ def readers_writer(
     return from_event_lists(per_core_evs)
 
 
+def lock_contention(
+    n_cores: int,
+    n_critical: int = 16,
+    n_locks: int = 2,
+    ins_per_mem: int = 2,
+    seed: int = 0,
+    line: int = 64,
+) -> Trace:
+    """Cores repeatedly acquire a few shared mutexes, touch the protected
+    data (load + store), and release — the pthread_mutex critical-section
+    shape the reference captures by interception (SURVEY.md §2 #1)."""
+    rng = _rng(seed)
+    per_core = []
+    for c in range(n_cores):
+        evs = []
+        for _ in range(n_critical):
+            lk = int(rng.integers(0, n_locks))
+            mtx = 0x10000 + lk * 4 * line  # mutex addresses, distinct lines
+            data = 0x80000 + lk * line  # protected data, one line per lock
+            evs.append((EV_LOCK, 0, mtx))
+            evs.append((EV_LD, 4, data))
+            evs.append((EV_ST, 4, data))
+            evs.append((EV_UNLOCK, 0, mtx))
+        per_core.append(_interleave(rng, evs, ins_per_mem))
+    return from_event_lists(per_core)
+
+
+def barrier_phases(
+    n_cores: int,
+    n_phases: int = 4,
+    work_per_phase: int = 12,
+    ins_per_mem: int = 2,
+    subset: bool = False,
+    seed: int = 0,
+    line: int = 64,
+) -> Trace:
+    """Bulk-synchronous phases: private strided work, then a barrier.
+
+    Barrier ids alternate over two slots to exercise slot reuse (count
+    reset + re-arm). With ``subset=True`` only the first half of the cores
+    participate (participant count = n_cores // 2), the rest free-run —
+    exercising per-waiter participant counts.
+    """
+    rng = _rng(seed)
+    half = max(1, n_cores // 2)
+    per_core: list[list] = [[] for _ in range(n_cores)]
+    for p in range(n_phases):
+        for c in range(n_cores):
+            base = (1 + c) * (1 << 14) + p * work_per_phase * line
+            evs = [(EV_LD, 4, base + i * line) for i in range(work_per_phase)]
+            evs.append((EV_ST, 4, base))
+            w = _interleave(rng, evs, ins_per_mem)
+            if subset:
+                if c < half:
+                    w.append((EV_BARRIER, half, p % 2))
+            else:
+                w.append((EV_BARRIER, n_cores, p % 2))
+            per_core[c].extend(w)
+    return from_event_lists(per_core)
+
+
 GENERATORS = {
     "uniform_random": uniform_random,
     "stream": stream,
@@ -198,4 +272,6 @@ GENERATORS = {
     "false_sharing": false_sharing,
     "fft_like": fft_like,
     "readers_writer": readers_writer,
+    "lock_contention": lock_contention,
+    "barrier_phases": barrier_phases,
 }

@@ -69,6 +69,16 @@ def assert_parity(cfg, trace, chunk_steps=64):
         g.sharers,
         err_msg="sharers",
     )
+    # synchronization state (phase 2.7): lock table, barrier tables, flags
+    np.testing.assert_array_equal(
+        np.asarray(e.state.lock_holder), g.lock_holder, err_msg="lock_holder"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(e.state.barrier_count), g.barrier_count, err_msg="barrier_count"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(e.state.sync_flag), g.sync_flag, err_msg="sync_flag"
+    )
     ec = e.counters
     for k, v in g.counters.items():
         np.testing.assert_array_equal(ec[k], v, err_msg=f"counter {k}")
@@ -90,6 +100,8 @@ GENS = {
     "false_sharing": lambda n: synth.false_sharing(n, n_mem_ops=60, seed=14),
     "fft_like": lambda n: synth.fft_like(n, n_phases=2, points_per_core=12, seed=15),
     "readers_writer": lambda n: synth.readers_writer(n, n_rounds=3, seed=16),
+    "lock_contention": lambda n: synth.lock_contention(n, n_critical=8, seed=17),
+    "barrier_phases": lambda n: synth.barrier_phases(n, n_phases=2, seed=18),
 }
 
 
