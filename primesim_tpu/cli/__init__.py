@@ -1,0 +1,188 @@
+"""`primetpu` command-line interface (SURVEY.md §2 #15).
+
+The reference is launched as a hand-composed mpirun MPMD line plus Pin
+invocation (SURVEY.md §3.1); the TPU-native framework collapses that into
+one CLI:
+
+    primetpu run configs/rung1_64core_fft.json --synth fft_like --report r.txt
+    primetpu run cfg.json --trace app.ptpu --engine jax
+    primetpu synth lock_contention:n_critical=32 --cores 64 --out lc.ptpu
+    primetpu info configs/rung3_1024core_o3.json
+
+`run` simulates a trace (from a PTPU file or a named synthetic generator)
+on a machine config, prints a one-line JSON summary (the bench.py format),
+and optionally writes the reference-style text report. Synth specs are
+`name[:key=int,...]` over primesim_tpu.trace.synth.GENERATORS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _parse_synth(spec: str, n_cores: int, fold: bool):
+    from ..trace import synth
+    from ..trace.format import fold_ins
+
+    name, _, args = spec.partition(":")
+    if name not in synth.GENERATORS:
+        raise SystemExit(
+            f"unknown generator {name!r}; have: {', '.join(sorted(synth.GENERATORS))}"
+        )
+    kw = {}
+    if args:
+        for pair in args.split(","):
+            k, eq, v = pair.partition("=")
+            if not eq or not k:
+                raise SystemExit(f"bad synth arg {pair!r} (want key=value)")
+            try:
+                kw[k] = int(v)
+            except ValueError:
+                raise SystemExit(
+                    f"bad synth arg {pair!r}: value must be an integer"
+                ) from None
+    try:
+        tr = synth.GENERATORS[name](n_cores, **kw)
+    except TypeError as e:
+        raise SystemExit(f"synth {name!r}: {e}") from None
+    return fold_ins(tr) if fold else tr
+
+
+def _load_trace(ns, n_cores: int):
+    from ..trace.format import Trace, fold_ins
+
+    if ns.trace:
+        tr = Trace.load(ns.trace)
+        return fold_ins(tr) if ns.fold else tr
+    if ns.synth:
+        return _parse_synth(ns.synth, n_cores, ns.fold)
+    raise SystemExit("run: need --trace FILE or --synth SPEC")
+
+
+def cmd_run(ns) -> int:
+    from ..config.machine import MachineConfig
+    from ..stats.report import write_report
+
+    with open(ns.config) as f:
+        cfg = MachineConfig.from_json(f.read())
+    tr = _load_trace(ns, cfg.n_cores)
+    if tr.n_cores != cfg.n_cores:
+        raise SystemExit(
+            f"trace has {tr.n_cores} cores but config has {cfg.n_cores}"
+        )
+
+    if ns.engine == "golden":
+        from ..golden.sim import GoldenSim
+
+        t0 = time.perf_counter()
+        sim = GoldenSim(cfg, tr)
+        sim.run(max_steps=ns.max_steps)
+        wall = time.perf_counter() - t0
+        cycles, counters = sim.cycles, sim.counters
+    else:
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from ..sim.engine import Engine, run_loop
+
+        # warm the jit cache at the measured shapes (one chunk) so the
+        # reported MIPS measures simulation, not compilation — the same
+        # protocol as bench.py; comparable numbers matter more than the
+        # one-off compile cost shown to an interactive user
+        warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
+        out = run_loop(
+            cfg, ns.chunk_steps, warm.events, warm.state,
+            jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+        )
+        np.asarray(out[0].cycles)  # block until compiled + run
+        eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
+        t0 = time.perf_counter()
+        eng.run(max_steps=ns.max_steps)
+        wall = time.perf_counter() - t0
+        cycles, counters = eng.cycles, eng.counters
+
+    tot_ins = int(counters["instructions"].sum())
+    summary = {
+        "metric": "simulated_MIPS",
+        "value": round(tot_ins / wall / 1e6, 3),
+        "unit": "MIPS",
+        "detail": {
+            "engine": ns.engine,
+            "n_cores": cfg.n_cores,
+            "instructions": tot_ins,
+            "max_core_cycles": int(max(cycles)),
+            "wall_s": round(wall, 3),
+            "noc_msgs": int(counters["noc_msgs"].sum()),
+        },
+    }
+    print(json.dumps(summary))
+    if ns.report:
+        write_report(
+            ns.report, cfg, counters, cycles, wall_s=wall,
+            per_core_limit=ns.per_core_limit,
+        )
+        print(f"report written to {ns.report}", file=sys.stderr)
+    return 0
+
+
+def cmd_synth(ns) -> int:
+    tr = _parse_synth(ns.spec, ns.cores, ns.fold)
+    tr.save(ns.out)
+    print(
+        f"wrote {ns.out}: {tr.n_cores} cores x {tr.max_len} events "
+        f"({tr.total_instructions():,} instructions)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_info(ns) -> int:
+    from ..config.machine import MachineConfig
+
+    with open(ns.config) as f:
+        cfg = MachineConfig.from_json(f.read())
+    print(cfg.to_json())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="primetpu",
+        description="TPU-native manycore architecture simulator (PriME-class)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="simulate a trace on a machine config")
+    r.add_argument("config", help="machine config JSON")
+    r.add_argument("--trace", help="PTPU trace file")
+    r.add_argument("--synth", help="synthetic workload spec name[:k=v,...]")
+    r.add_argument(
+        "--fold", action="store_true", help="fold INS batches into pre fields"
+    )
+    r.add_argument("--engine", choices=("jax", "golden"), default="jax")
+    r.add_argument("--chunk-steps", type=int, default=256)
+    r.add_argument("--max-steps", type=int, default=10_000_000)
+    r.add_argument("--report", help="write text report to this path")
+    r.add_argument("--per-core-limit", type=int, default=64)
+    r.set_defaults(fn=cmd_run)
+
+    s = sub.add_parser("synth", help="generate a synthetic PTPU trace file")
+    s.add_argument("spec", help="generator spec name[:k=v,...]")
+    s.add_argument("--cores", type=int, required=True)
+    s.add_argument("--out", required=True)
+    s.add_argument("--fold", action="store_true")
+    s.set_defaults(fn=cmd_synth)
+
+    i = sub.add_parser("info", help="parse + print a machine config")
+    i.add_argument("config")
+    i.set_defaults(fn=cmd_info)
+    return p
+
+
+def main(argv=None) -> int:
+    ns = build_parser().parse_args(argv)
+    return ns.fn(ns)

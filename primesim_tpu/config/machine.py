@@ -52,12 +52,15 @@ class CoreConfig:
     """In-order core timing model (SURVEY.md §2 #2: CoreManager).
 
     `cpi` is the cycles-per-instruction for non-memory instructions. A
-    heterogeneous (big.LITTLE-style) machine supplies `cpi_per_core`, one
-    entry per core, which overrides `cpi`.
+    heterogeneous (big.LITTLE-style) machine supplies `cpi_per_core` (one
+    entry per core) or the compact `cpi_pattern` (tiled across cores, e.g.
+    (1, 1, 3, 3) for alternating big/LITTLE pairs); per-core overrides
+    pattern overrides `cpi`.
     """
 
     cpi: int = 1
     cpi_per_core: tuple[int, ...] | None = None
+    cpi_pattern: tuple[int, ...] | None = None
     # O3-style overlap model (0 = pure in-order). Fraction (in 1/256ths) of a
     # miss latency hidden by the out-of-order window; applied as
     # charged = lat - (lat * o3_overlap_256 >> 8), still integer-exact.
@@ -68,6 +71,9 @@ class CoreConfig:
             if len(self.cpi_per_core) != n_cores:
                 raise ValueError("cpi_per_core length != n_cores")
             return tuple(self.cpi_per_core)
+        if self.cpi_pattern is not None:
+            p = self.cpi_pattern
+            return tuple(p[i % len(p)] for i in range(n_cores))
         return (self.cpi,) * n_cores
 
     def validate(self) -> None:
@@ -75,6 +81,10 @@ class CoreConfig:
             self.cpi_per_core is not None and any(c < 1 for c in self.cpi_per_core)
         ):
             raise ValueError("core cpi values must be >= 1")
+        if self.cpi_pattern is not None and (
+            not self.cpi_pattern or any(c < 1 for c in self.cpi_pattern)
+        ):
+            raise ValueError("cpi_pattern must be non-empty with values >= 1")
         if not (0 <= self.o3_overlap_256 < 256):
             raise ValueError("o3_overlap_256 must be in [0, 256)")
 
@@ -173,6 +183,8 @@ class MachineConfig:
             c = dict(d["core"])
             if c.get("cpi_per_core") is not None:
                 c["cpi_per_core"] = tuple(c["cpi_per_core"])
+            if c.get("cpi_pattern") is not None:
+                c["cpi_pattern"] = tuple(c["cpi_pattern"])
             d["core"] = CoreConfig(**c)
         if "l1" in d and isinstance(d["l1"], dict):
             d["l1"] = CacheConfig(**d["l1"])
