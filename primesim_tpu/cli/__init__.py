@@ -62,12 +62,21 @@ def _load_trace(ns, n_cores: int):
     raise SystemExit("run: need --trace FILE or --synth SPEC")
 
 
-def cmd_run(ns) -> int:
+def _load_config(path: str):
+    if path.endswith(".xml"):
+        from ..config.xml_compat import load_xml
+
+        return load_xml(path)
     from ..config.machine import MachineConfig
+
+    with open(path) as f:
+        return MachineConfig.from_json(f.read())
+
+
+def cmd_run(ns) -> int:
     from ..stats.report import write_report
 
-    with open(ns.config) as f:
-        cfg = MachineConfig.from_json(f.read())
+    cfg = _load_config(ns.config)
     tr = _load_trace(ns, cfg.n_cores)
     if tr.n_cores != cfg.n_cores:
         raise SystemExit(
@@ -141,11 +150,7 @@ def cmd_synth(ns) -> int:
 
 
 def cmd_info(ns) -> int:
-    from ..config.machine import MachineConfig
-
-    with open(ns.config) as f:
-        cfg = MachineConfig.from_json(f.read())
-    print(cfg.to_json())
+    print(_load_config(ns.config).to_json())
     return 0
 
 
@@ -157,7 +162,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     r = sub.add_parser("run", help="simulate a trace on a machine config")
-    r.add_argument("config", help="machine config JSON")
+    r.add_argument("config", help="machine config (.json or reference-schema .xml)")
     r.add_argument("--trace", help="PTPU trace file")
     r.add_argument("--synth", help="synthetic workload spec name[:k=v,...]")
     r.add_argument(
@@ -185,4 +190,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     ns = build_parser().parse_args(argv)
-    return ns.fn(ns)
+    try:
+        return ns.fn(ns)
+    except BrokenPipeError:  # e.g. `primetpu info cfg | head`
+        return 0

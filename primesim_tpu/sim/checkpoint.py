@@ -1,0 +1,92 @@
+"""Checkpoint / resume (SURVEY.md §5.4).
+
+The reference has no checkpointing — runs are one-shot. Here the entire
+simulated machine is one pytree (the scan carry, `MachineState`) plus a
+handful of host-side accumulators, so a checkpoint is a single `.npz`:
+every state field, the 64-bit counter/clock bases, and fingerprints of the
+config and trace (resuming against a different machine or workload is an
+error, not silent corruption). Quantum boundaries need no special casing —
+any step boundary is a consistent cut.
+
+Bit-exactness contract: run(A+B steps) == run(A) -> save -> load -> run(B),
+for cycles, counters, and all cache/directory/sync state
+(tests/test_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.machine import MachineConfig
+from ..stats.counters import COUNTER_NAMES
+from .state import MachineState
+
+_FORMAT = 1
+
+
+def trace_fingerprint(trace) -> str:
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(trace.events).tobytes())
+    h.update(np.ascontiguousarray(trace.lengths).tobytes())
+    return h.hexdigest()
+
+
+def save_checkpoint(path: str, engine) -> None:
+    """Snapshot an Engine mid-run (drains device counters first)."""
+    engine._drain()
+    st = engine.state
+    arrays = {f"state_{k}": np.asarray(v) for k, v in st._asdict().items()}
+    arrays["host_counters"] = np.stack(
+        [engine.host_counters[k] for k in COUNTER_NAMES]
+    )
+    np.savez_compressed(
+        path,
+        format=np.int64(_FORMAT),
+        cycle_base=np.int64(engine.cycle_base),
+        steps_run=np.int64(engine.steps_run),
+        config_json=np.frombuffer(
+            engine.cfg.to_json().encode(), dtype=np.uint8
+        ),
+        trace_sha=np.frombuffer(
+            trace_fingerprint(engine.trace).encode(), dtype=np.uint8
+        ),
+        **arrays,
+    )
+
+
+def load_checkpoint(path: str, engine) -> None:
+    """Restore a snapshot into a freshly-constructed Engine.
+
+    The engine must have been built with the same MachineConfig and Trace
+    the checkpoint was taken under (validated by fingerprint).
+    """
+    z = np.load(path)
+    if int(z["format"]) != _FORMAT:
+        raise ValueError(f"{path}: unsupported checkpoint format {int(z['format'])}")
+    cfg_json = bytes(z["config_json"]).decode()
+    if MachineConfig.from_json(cfg_json) != engine.cfg:
+        raise ValueError(f"{path}: checkpoint config does not match engine config")
+    sha = bytes(z["trace_sha"]).decode()
+    if sha != trace_fingerprint(engine.trace):
+        raise ValueError(f"{path}: checkpoint trace does not match engine trace")
+    fields = {
+        k: jnp.asarray(z[f"state_{k}"]) for k in MachineState._fields
+    }
+    st = MachineState(**fields)
+    if engine.mesh is not None:
+        # restore the multi-chip layout Engine.__init__ applies — without
+        # this the full state materializes unsharded on one device
+        from ..parallel.sharding import shard_state
+
+        st = shard_state(engine.mesh, st)
+    engine.state = st
+    engine.cycle_base = np.int64(z["cycle_base"])
+    engine.steps_run = int(z["steps_run"])
+    hc = z["host_counters"]
+    engine.host_counters = {
+        k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
+    }

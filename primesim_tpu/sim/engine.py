@@ -853,6 +853,26 @@ class Engine:
             self.events = shard_events(mesh, self.events)
             self.state = shard_state(mesh, self.state)
         self.chunk_steps = chunk_steps
+        # Counter-accumulator guard (run_loop drains int32 step counters
+        # into (lo, hi) pairs whose hi carries above 2^30): any per-core
+        # counter's per-CHUNK increment must stay < 2^30. The largest
+        # per-step increment is the instructions counter, bounded by
+        # (local_run_len + 1) events each retiring at most max(arg, pre+1)
+        # instructions.
+        ev = trace.events
+        per_ev = max(
+            1,
+            int(ev[:, :, 1].max(initial=0)),
+            int(ev[:, :, 3].max(initial=0)) + 1,
+        )
+        per_step = (cfg.local_run_len + 1) * per_ev
+        if chunk_steps * per_step >= 1 << _ACC_BITS:
+            raise ValueError(
+                f"chunk_steps={chunk_steps} x max per-step instruction "
+                f"increment {per_step} overflows the 2^{_ACC_BITS} "
+                "per-chunk counter accumulator; lower chunk_steps or split "
+                "large INS batches"
+            )
         self.cycle_base = np.int64(0)
         self.host_counters = zero_counters(cfg.n_cores)
         self.steps_run = 0
@@ -893,7 +913,12 @@ class Engine:
         return bool((self._event_types_at_ptr() == EV_END).all())
 
     def run(self, max_steps: int = 10_000_000) -> None:
-        """Run to completion in ONE device dispatch (preferred path)."""
+        """Run to completion in ONE device dispatch (preferred path).
+
+        `max_steps` is a deadlock guard, rounded UP to a whole number of
+        `chunk_steps` chunks (the device loop cannot stop mid-chunk): up
+        to chunk_steps-1 extra steps may execute before the guard trips.
+        """
         max_chunks = -(-max_steps // self.chunk_steps)
         st, acc_lo, acc_hi, base_lo, base_hi, k = run_loop(
             self.cfg,
@@ -924,7 +949,17 @@ class Engine:
         inspectable between chunks) and as the reference for the fused
         loop's on-device bookkeeping.
         """
-        while self.steps_run < max_steps and not self.done():
+        self.run_steps(max_steps - self.steps_run)
+        if not self.done():
+            raise RuntimeError("engine: max_steps exceeded (deadlock?)")
+
+    def run_steps(self, n_steps: int) -> None:
+        """Advance exactly `n_steps` (rounded up to whole chunks) WITHOUT
+        the completion check — the building block for checkpointed runs:
+        run_steps(A) -> save_checkpoint -> (later) load_checkpoint ->
+        run() is bit-exact with an uninterrupted run()."""
+        target = self.steps_run + n_steps
+        while self.steps_run < target and not self.done():
             self.state = run_chunk(
                 self.cfg, self.chunk_steps, self.events, self.state,
                 has_sync=self.has_sync,
@@ -932,8 +967,18 @@ class Engine:
             self.steps_run += self.chunk_steps
             self._drain()
             self._rebase()
-        if not self.done():
-            raise RuntimeError("engine: max_steps exceeded (deadlock?)")
+
+    # ---- checkpoint / resume (SURVEY.md §5.4) ----------------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        from .checkpoint import save_checkpoint
+
+        save_checkpoint(path, self)
+
+    def load_checkpoint(self, path: str) -> None:
+        from .checkpoint import load_checkpoint
+
+        load_checkpoint(path, self)
 
     # ---- results ---------------------------------------------------------
 

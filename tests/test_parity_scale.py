@@ -1,0 +1,77 @@
+"""Engine-vs-golden parity at 64 and 256 cores (SURVEY.md §4a).
+
+The flagship configs run NW > 1 sharer words (NW = ceil(cores/32)); these
+tests pin the multi-word paths the small-parity suite never touches: the
+`vsh` word-select in the L1 probes, the `unpack_bits` reshape to [C, C],
+the masked `join_word` scatter, and back-invalidation over sharers above
+bit 31. `readers_writer` populates every sharer word (verified: word 7 at
+256 cores); `false_sharing` then invalidates across them.
+"""
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+from primesim_tpu.trace import synth
+
+from test_parity import assert_parity
+
+
+def scale_machine(n_cores: int, mesh_x: int, mesh_y: int, **kw) -> MachineConfig:
+    d = dict(
+        n_cores=n_cores,
+        n_banks=min(n_cores, 64),
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=16384, ways=4, line=64, latency=10),
+        noc=NocConfig(mesh_x=mesh_x, mesh_y=mesh_y, link_lat=1, router_lat=1),
+        dram_lat=100,
+        quantum=500,
+    )
+    d.update(kw)
+    return MachineConfig(**d)
+
+
+@pytest.mark.parametrize(
+    "gen",
+    [
+        lambda n: synth.readers_writer(n, n_rounds=2, block_lines=4, seed=31),
+        lambda n: synth.false_sharing(n, n_mem_ops=24, n_hot_lines=4, seed=32),
+    ],
+    ids=["readers_writer", "false_sharing"],
+)
+def test_parity_64core_two_sharer_words(gen):
+    cfg = scale_machine(64, 8, 8)
+    assert_parity(cfg, gen(64), chunk_steps=64)
+
+
+def test_parity_64core_sync():
+    # locks + barriers with cores above bit 31 in the sync tables
+    cfg = scale_machine(64, 8, 8)
+    assert_parity(
+        cfg, synth.barrier_phases(64, n_phases=2, work_per_phase=6, seed=33),
+        chunk_steps=64,
+    )
+    assert_parity(
+        cfg, synth.lock_contention(64, n_critical=4, n_locks=4, seed=34),
+        chunk_steps=64,
+    )
+
+
+def test_parity_256core_eight_sharer_words():
+    # all 8 sharer words populated (readers_writer: every core shares the
+    # producer's block); back-invalidation + upgrade invalidations sweep
+    # the full word range
+    cfg = scale_machine(256, 16, 16)
+    tr = synth.readers_writer(256, n_rounds=2, block_lines=4, seed=35)
+    g_sharer_words = MachineConfig.n_sharer_words.fget(cfg)
+    assert g_sharer_words == 8
+    assert_parity(cfg, tr, chunk_steps=80)
+
+
+def test_parity_256core_false_sharing_local_runs():
+    cfg = scale_machine(256, 16, 16, local_run_len=4)
+    assert_parity(
+        cfg,
+        synth.false_sharing(256, n_mem_ops=16, n_hot_lines=2, seed=36),
+        chunk_steps=80,
+    )
