@@ -1,0 +1,80 @@
+"""Execution-capture bridge: build + drive the LD_PRELOAD frontend.
+
+Host-side half of the execution-driven mode (SURVEY.md §2 #1/#8): compiles
+the native capture shim (`primesim_tpu/frontend/ptpu_capture.cpp`) on
+demand, runs a real multithreaded binary under it, and loads the PTPU v3
+trace it emits — the trace then drives the simulation engines exactly like
+a synthetic one.
+
+    from primesim_tpu.ingest.capture import capture_run
+    trace = capture_run(["./my_pthread_app", "args"], line=64)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+from ..trace.format import Trace
+
+_FRONTEND_DIR = os.path.join(os.path.dirname(__file__), "..", "frontend")
+
+
+def build_shim(out_dir: str | None = None, cxx: str = "g++") -> str:
+    """Compile the capture shim (cached on mtime); returns the .so path."""
+    src = os.path.abspath(os.path.join(_FRONTEND_DIR, "ptpu_capture.cpp"))
+    out_dir = out_dir or os.path.abspath(_FRONTEND_DIR)
+    so = os.path.join(out_dir, "libptpu_capture.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    cmd = [
+        cxx, "-O2", "-shared", "-fPIC", "-o", so, src, "-ldl", "-lpthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return so
+
+
+def capture_run(
+    cmd: list[str],
+    *,
+    trace_out: str | None = None,
+    capture_memops: bool = True,
+    line: int = 64,
+    max_cores: int = 256,
+    max_events: int = 1 << 20,
+    memop_max_lines: int = 64,
+    timeout: float | None = 120.0,
+    env: dict[str, str] | None = None,
+) -> Trace:
+    """Run `cmd` under the capture shim and return the captured Trace."""
+    so = build_shim()
+    tmp = None
+    if trace_out is None:
+        fd, tmp = tempfile.mkstemp(suffix=".ptpu")
+        os.close(fd)
+        trace_out = tmp
+    run_env = dict(os.environ if env is None else env)
+    preload = run_env.get("LD_PRELOAD", "")
+    run_env.update(
+        LD_PRELOAD=(so + (" " + preload if preload else "")),
+        PTPU_TRACE_OUT=trace_out,
+        PTPU_CAPTURE_MEMOPS="1" if capture_memops else "0",
+        PTPU_LINE=str(line),
+        PTPU_MAX_CORES=str(max_cores),
+        PTPU_MAX_EVENTS=str(max_events),
+        PTPU_MEMOP_MAX_LINES=str(memop_max_lines),
+    )
+    try:
+        proc = subprocess.run(
+            cmd, env=run_env, timeout=timeout, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"capture_run: {cmd!r} exited {proc.returncode}\n"
+                f"stderr:\n{proc.stderr}"
+            )
+        return Trace.load(trace_out)
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            os.unlink(tmp)

@@ -1,0 +1,112 @@
+"""Execution-capture frontend (SURVEY.md §2 #1): build the LD_PRELOAD
+shim, capture a REAL multithreaded pthread binary (ocean_like: grid
+relaxation phases + mutex-protected reduction + global barriers), and
+prove the captured trace simulates with golden/engine bit-exact parity —
+the reference's defining capability (simulating real programs), VERDICT
+round-3 item #3.
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+from primesim_tpu.ingest.capture import build_shim, capture_run
+from primesim_tpu.trace.format import (
+    EV_BARRIER,
+    EV_LD,
+    EV_LOCK,
+    EV_ST,
+    EV_UNLOCK,
+)
+
+from test_parity import assert_parity
+
+FRONTEND = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "primesim_tpu",
+    "frontend",
+)
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("gcc") is None,
+    reason="native toolchain unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def ocean_binary(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("frontend") / "ocean_like")
+    # -fno-builtin keeps memcpy/memset as libc PLT calls the shim can
+    # interpose (fully optimized builds may inline them; sync capture is
+    # unaffected either way)
+    subprocess.run(
+        [
+            "gcc", "-O2", "-fno-builtin", "-o", out,
+            os.path.join(FRONTEND, "examples", "ocean_like.c"), "-lpthread",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return out
+
+
+N_THREADS, N_PHASES, ROWS = 4, 3, 4
+LINES_PER_ROW = 256 * 8 // 64  # COLS doubles per 64B line
+
+
+@pytest.fixture(scope="module")
+def captured(ocean_binary):
+    build_shim()
+    return capture_run(
+        [ocean_binary, str(N_THREADS), str(N_PHASES), str(ROWS)], line=64
+    )
+
+
+def test_capture_structure(captured):
+    t = captured
+    assert t.n_cores == N_THREADS + 1  # workers + main thread (core 0)
+    types = t.events[:, :, 0]
+    for c in range(1, t.n_cores):  # each worker thread
+        row = types[c, : t.lengths[c]]
+        assert (row == EV_LOCK).sum() == N_PHASES
+        assert (row == EV_UNLOCK).sum() == N_PHASES
+        assert (row == EV_BARRIER).sum() == N_PHASES
+        # phase row copy-backs: >= rows*phases*lines LD and ST from memcpy
+        assert (row == EV_LD).sum() >= N_PHASES * ROWS * LINES_PER_ROW
+        assert (row == EV_ST).sum() >= N_PHASES * ROWS * LINES_PER_ROW
+    # barrier events carry the registered participant count and dense id 0
+    bar = t.events[:, :, 0] == EV_BARRIER
+    assert (t.events[:, :, 1][bar] == N_THREADS).all()
+    assert (t.events[:, :, 2][bar] == 0).all()
+    # all worker threads hammer the same mutex address
+    lock_addrs = t.events[:, :, 2][t.events[:, :, 0] == EV_LOCK]
+    assert len(np.unique(lock_addrs)) == 1
+
+
+def test_captured_trace_simulates_with_parity(captured):
+    # the "downscaled copy": same capture, small machine — golden vs JAX
+    # engine bit-exact on a real program's trace, locks and barriers
+    # included
+    cfg = MachineConfig(
+        n_cores=captured.n_cores,
+        n_banks=4,
+        l1=CacheConfig(size=2048, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=16384, ways=4, line=64, latency=10),
+        noc=NocConfig(mesh_x=2, mesh_y=2, link_lat=1, router_lat=1),
+        dram_lat=100,
+        quantum=10_000,
+    )
+    assert_parity(cfg, captured, chunk_steps=64)
+
+
+def test_capture_memops_off(ocean_binary):
+    t = capture_run(
+        [ocean_binary, "2", "1", "1"], capture_memops=False
+    )
+    types = t.events[:, :, 0]
+    assert ((types == EV_LD) | (types == EV_ST)).sum() == 0
+    assert (types == EV_BARRIER).sum() == 2  # sync still captured
