@@ -91,12 +91,25 @@ class CoreConfig:
 
 @dataclass(frozen=True)
 class NocConfig:
-    """2-D mesh NoC (SURVEY.md §2 #6: Network, XY routing, hop-by-hop)."""
+    """2-D mesh NoC (SURVEY.md §2 #6: Network, XY routing, hop-by-hop).
+
+    `contention=True` enables the router-occupancy queueing model: every
+    uncore transaction served at a home tile in the same step (memory
+    winners + read-joins at their home bank, lock/unlock RMWs at the
+    lock's home, barrier arrivals at the barrier's home) queues behind
+    the others — each is charged `contention_lat * (n_at_tile - 1)` extra
+    cycles, making hot-bank latency load-dependent (BASELINE rung 3
+    "NoC-congestion heavy"). Identical in both engines; charged before
+    the O3 overlap reduction. Hop-by-hop per-link routing stays the
+    planned Pallas v2.
+    """
 
     mesh_x: int = 8
     mesh_y: int = 8
     link_lat: int = 1  # per-hop link traversal, cycles
     router_lat: int = 1  # per-router, cycles ((hops+1) routers on a path)
+    contention: bool = False
+    contention_lat: int = 1  # queueing cycles per concurrent transaction
 
     @property
     def n_tiles(self) -> int:
@@ -148,6 +161,8 @@ class MachineConfig:
             raise ValueError("dram_lat must be >= 0")
         if self.noc.link_lat < 0 or self.noc.router_lat < 0:
             raise ValueError("NoC latencies must be >= 0")
+        if self.noc.contention_lat < 0:
+            raise ValueError("contention_lat must be >= 0")
         if self.noc.mesh_x < 1 or self.noc.mesh_y < 1:
             raise ValueError("mesh dims must be >= 1")
         if not (0 <= self.local_run_len <= 64):

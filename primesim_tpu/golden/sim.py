@@ -112,6 +112,15 @@ class GoldenSim:
         self.counters["noc_hops"][c] += _hops(tile_a, tile_b, self.cfg.noc.mesh_x)
         return lat
 
+    def _contention_extra(self, c: int, tile: int) -> int:
+        """Router-occupancy queueing charge for core c's transaction at
+        `tile` this step (0 when the model is disabled)."""
+        if not self.cfg.noc.contention:
+            return 0
+        extra = self.cfg.noc.contention_lat * (self._tile_txns.get(tile, 1) - 1)
+        self.counters["noc_contention_cycles"][c] += extra
+        return extra
+
     # --------------------------------------------------------------- step
 
     def done(self) -> bool:
@@ -290,11 +299,12 @@ class GoldenSim:
         arb_slots = {
             (self._bank(r[3]), self._bank_set(r[3])) for r in requests
         }
+        join_go = []
         for c, line, pre in joins:
             if (self._bank(line), self._bank_set(line)) in arb_slots:
                 requests.append((int(self.cycles[c]), c, GETS, line, pre))
             else:
-                self._do_join(c, line, pre, step)
+                join_go.append((c, line, pre))
 
         by_bankset: dict[tuple[int, int], list] = {}
         for r in requests:
@@ -306,6 +316,32 @@ class GoldenSim:
             winners.append(rs[0])
             for r in rs[1:]:
                 self.counters["retries"][r[1]] += 1
+
+        # --- router-occupancy contention counts (NocConfig.contention) ----
+        # Every uncore transaction served at a home tile this step queues
+        # behind the others there: memory winners + joins at their home
+        # bank tile, lock/unlock RMWs at the lock's home tile, barrier
+        # arrivals at the barrier's home tile. Counts are fixed BEFORE any
+        # charging so the extra is identical for every transaction at the
+        # tile (matching the engine's one-scatter count).
+        self._tile_txns = {}
+        if cfg.noc.contention:
+            def _bump(t):
+                self._tile_txns[t] = self._tile_txns.get(t, 0) + 1
+
+            for _, _, _, line, _ in winners:
+                _bump(bank_tile(self._bank(line), cfg))
+            for _, line, _ in join_go:
+                _bump(bank_tile(self._bank(line), cfg))
+            for _, addr, _ in unlocks:
+                _bump(self._lock_home_tile(addr))
+            for _, _, addr, _ in lock_reqs:
+                _bump(self._lock_home_tile(addr))
+            for _, bid, _, _ in barrier_arr:
+                _bump(bid % cfg.n_tiles)
+
+        for c, line, pre in join_go:
+            self._do_join(c, line, pre, step)
 
         # --- phase 3: transitions on step-start state; collect phase-B ops -
         # Phase-B op = (core, line, op) with op in {"downgrade","invalidate"}
@@ -434,6 +470,7 @@ class GoldenSim:
                     grant = M
 
             lat += self._noc(c, btile, ctile)  # reply
+            lat += self._contention_extra(c, btile)
 
             # O3-style overlap: hide a fraction of the miss latency
             ov = cfg.core.o3_overlap_256
@@ -488,6 +525,7 @@ class GoldenSim:
             h = self._lock_home_tile(addr)
             ctile = core_tile(c, cfg)
             lat = self._noc(c, ctile, h) + cfg.llc.latency + self._noc(c, h, ctile)
+            lat += self._contention_extra(c, h)
             self.cycles[c] += pre * int(self.cpi[c]) + lat
             self.counters["instructions"][c] += pre + 1
             if self.lock_holder[s] == c:
@@ -508,6 +546,7 @@ class GoldenSim:
                     + cfg.llc.latency
                     + self._noc(c, h, ctile)
                 )
+                lat += self._contention_extra(c, h)
                 if self.sync_flag[c] == 0:  # first attempt: charge pre batch
                     self.cycles[c] += pre * int(self.cpi[c])
                     self.counters["instructions"][c] += pre
@@ -529,6 +568,7 @@ class GoldenSim:
             self.cycles[c] += pre * int(self.cpi[c])
             self.counters["instructions"][c] += pre
             self.cycles[c] += self._noc(c, ctile, h)  # arrival message
+            self.cycles[c] += self._contention_extra(c, h)
             self.counters["barrier_waits"][c] += 1
             self.sync_flag[c] = 1
             self.barrier_count[bid] += 1
@@ -594,6 +634,7 @@ class GoldenSim:
         self._set_sharer(b, bs, w, c, True)
         self.llc_lru[b, bs, w] = step
         lat += self._noc(c, btile, ctile)
+        lat += self._contention_extra(c, btile)
         ov = cfg.core.o3_overlap_256
         if ov:
             lat = lat - ((lat * ov) >> 8)

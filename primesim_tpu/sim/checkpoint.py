@@ -73,6 +73,12 @@ def load_checkpoint(path: str, engine) -> None:
     sha = bytes(z["trace_sha"]).decode()
     if sha != trace_fingerprint(engine.trace):
         raise ValueError(f"{path}: checkpoint trace does not match engine trace")
+    if z["state_counters"].shape[0] != len(COUNTER_NAMES):
+        raise ValueError(
+            f"{path}: checkpoint has {z['state_counters'].shape[0]} counter "
+            f"rows but this build defines {len(COUNTER_NAMES)} — saved by an "
+            "incompatible version"
+        )
     fields = {
         k: jnp.asarray(z[f"state_{k}"]) for k in MachineState._fields
     }

@@ -345,6 +345,40 @@ def step(
     req_lat, req_hops = _one_way(ctile, btile, cfg)
     rep_lat, rep_hops = _one_way(btile, ctile, cfg)
 
+    # barrier home tile (bid lives in the addr field; ids validated
+    # < barrier_slots at ingest) — shared by the contention count and the
+    # phase-2.7 arrival/release paths
+    bid = jnp.where(et == EV_BARRIER, eaddr, 0)
+    htile = bid % n_tiles
+
+    # ---- router-occupancy contention (NocConfig.contention) --------------
+    # Count this step's uncore transactions per home tile (memory winners +
+    # joins at the home bank; lock/unlock RMWs at the lock's home == the
+    # same btile; barrier arrivals at bid % n_tiles), then charge each
+    # transaction contention_lat * (count - 1) — mirroring golden's
+    # _tile_txns/_contention_extra exactly.
+    if cfg.noc.contention:
+        ccl = cfg.noc.contention_lat
+        tcnt = jnp.zeros(n_tiles, jnp.int32)
+        home_txn = winner | join
+        if has_sync:
+            home_txn = home_txn | is_lock | is_unlock
+        tcnt = tcnt.at[jnp.where(home_txn, btile, n_tiles)].add(1, mode="drop")
+        if has_sync:
+            tcnt = tcnt.at[jnp.where(is_barrier, htile, n_tiles)].add(
+                1, mode="drop"
+            )
+        extra_home = ccl * (tcnt[btile] - 1)  # valid where home_txn
+        extra_bar = ccl * (tcnt[htile] - 1)  # valid where is_barrier
+        cnt = cadd(
+            cnt,
+            "noc_contention_cycles",
+            jnp.where(home_txn, extra_home, 0)
+            + (jnp.where(is_barrier, extra_bar, 0) if has_sync else 0),
+        )
+    else:
+        extra_home = extra_bar = jnp.zeros(C, jnp.int32)
+
     llc_hit = llc_has & winner
     llc_miss = winner & ~llc_has
 
@@ -397,13 +431,13 @@ def step(
     lat = lat + jnp.where(probe_any, 2 * po_lat, 0)
     lat = lat + jnp.where(write_w & llc_hit, inv_lat, 0)
     lat = lat + jnp.where(llc_miss, cfg.dram_lat, 0)
-    lat = lat + rep_lat
+    lat = lat + rep_lat + extra_home
     ov = cfg.core.o3_overlap_256
     if ov:
         lat = lat - ((lat * ov) >> 8)
 
     # join path latency: plain uncore round trip, no probe/inv/DRAM extras
-    lat_join = cfg.l1.latency + req_lat + cfg.llc.latency + rep_lat
+    lat_join = cfg.l1.latency + req_lat + cfg.llc.latency + rep_lat + extra_home
     if ov:
         lat_join = lat_join - ((lat_join * ov) >> 8)
 
@@ -611,7 +645,7 @@ def step(
         lslot = line & (L - 1)
         lreq_lat, lreq_hops = req_lat, req_hops
         lrep_lat, lrep_hops = rep_lat, rep_hops
-        lat_rt = lreq_lat + cfg.llc.latency + lrep_lat
+        lat_rt = lreq_lat + cfg.llc.latency + lrep_lat + extra_home
 
         # unlocks: every unlock is a charged RMW round trip to the lock's
         # home; the slot is released only if this core actually holds it
@@ -663,12 +697,13 @@ def step(
         ptr = ptr + grant.astype(jnp.int32)
 
         # barrier arrivals: charge pre + the arrival message, freeze the
-        # core, bump the slot's count and max-arrival clock
-        bid = jnp.where(et == EV_BARRIER, eaddr, 0)  # ids validated < BS
-        htile = bid % n_tiles
+        # core, bump the slot's count and max-arrival clock (bid/htile
+        # hoisted above the contention block)
         barr_lat, barr_hops = _one_way(ctile, htile, cfg)
         wake_lat, wake_hops = _one_way(htile, ctile, cfg)
-        cycles = cycles + jnp.where(is_barrier, epre * cpi_vec + barr_lat, 0)
+        cycles = cycles + jnp.where(
+            is_barrier, epre * cpi_vec + barr_lat + extra_bar, 0
+        )
         cnt = cadd(cnt, "instructions", jnp.where(is_barrier, epre, 0))
         cnt = cadd(cnt, "barrier_waits", is_barrier)
         cnt = cadd(cnt, "noc_msgs", is_barrier)

@@ -33,6 +33,7 @@ COUNTER_NAMES = (
     "lock_acquires",   # LOCK events retired
     "lock_spins",      # failed LOCK attempts (charged spin round trips)
     "barrier_waits",   # BARRIER arrivals
+    "noc_contention_cycles",  # router-occupancy queueing cycles charged
 )
 
 
