@@ -141,6 +141,13 @@ class MachineConfig:
     # contention); barrier ids must be dense ints < `barrier_slots`.
     lock_slots: int = 1024
     barrier_slots: int = 64
+    # Sharer-reduction chunking (BASELINE rungs 4-5 memory bound): 0 =
+    # dense [C, C] expansion of sharer bit-vectors for invalidation/
+    # back-invalidation reductions (fastest at <= 1024 cores); K > 0 =
+    # lax.scan over K-word blocks of the packed sharer words, bounding
+    # per-step temporaries to [C, 32K] instead of [C, C] (4096+ cores).
+    # Bit-exact either way. K must divide ceil(n_cores / 32).
+    sharer_chunk_words: int = 0
 
     def __post_init__(self):
         self.validate()
@@ -171,6 +178,15 @@ class MachineConfig:
             raise ValueError("lock_slots must be a power of two")
         if not _is_pow2(self.barrier_slots):
             raise ValueError("barrier_slots must be a power of two")
+        if self.sharer_chunk_words < 0:
+            raise ValueError("sharer_chunk_words must be >= 0")
+        if self.sharer_chunk_words and (
+            self.n_sharer_words % self.sharer_chunk_words
+        ):
+            raise ValueError(
+                f"sharer_chunk_words={self.sharer_chunk_words} must divide "
+                f"n_sharer_words={self.n_sharer_words}"
+            )
 
     # Derived geometry used by both engines --------------------------------
 

@@ -8,8 +8,8 @@
 // (PERF_COUNT_HW_INSTRUCTIONS per thread; falls back to a TSC-based
 // estimate, then to zero, when perf is unavailable in the container), and
 // optionally captures memcpy/memset as line-granular LD/ST traffic. On
-// process exit it writes a PTPU v3 binary trace (primesim_tpu/trace/
-// format.py layout) ready for `primetpu run --trace`.
+// process exit it writes a PTPU v4 binary trace (primesim_tpu/trace/
+// format.py layout, line_addressed flag) ready for `primetpu run --trace`.
 //
 // Environment:
 //   PTPU_TRACE_OUT      output path (default ptpu_capture.ptpu)
@@ -19,9 +19,14 @@
 //   PTPU_LINE           cache-line bytes for memop expansion (default 64)
 //   PTPU_MEMOP_MAX_LINES max lines emitted per memcpy/memset (default 64)
 //
-// Addresses are masked to 31 bits (the PTPU v1-v3 address width; aliasing
-// is line-preserving). Mutex addresses identify the lock; barrier ids are
-// dense registration indices with the participant count taken from
+// Addresses are emitted LINE-granular (PTPU v4 line_addressed flag): the
+// 31-bit addr field holds `byte_address / PTPU_LINE`, widening coverage
+// 64x over byte addressing (2^31 lines = 128 GiB at 64-byte lines; line
+// indices beyond that still alias under the 31-bit mask — a 2x32-bit
+// record is the future fully-un-aliased path). The capture line size is
+// recorded in flags bits 8-15 so engines reject mismatched configs.
+// Mutex addresses identify the lock by line; barrier ids are dense
+// registration indices with the participant count from
 // pthread_barrier_init.
 //
 // Build: g++ -O2 -shared -fPIC -o libptpu_capture.so ptpu_capture.cpp -ldl -lpthread
@@ -45,7 +50,8 @@ namespace {
 constexpr int32_t EV_INS = 0, EV_LD = 1, EV_ST = 2, EV_END = 3;
 constexpr int32_t EV_LOCK = 4, EV_UNLOCK = 5, EV_BARRIER = 6;
 constexpr uint32_t PTPU_MAGIC = 0x50545055u;
-constexpr uint32_t PTPU_VERSION = 3;
+constexpr uint32_t PTPU_VERSION = 4;
+constexpr uint32_t FLAG_LINE_ADDRESSED = 1;  // v4: addr = line index
 constexpr int32_t ADDR_MASK = 0x7fffffff;
 // Per-event instruction-batch cap: keeps the engine's per-chunk counter
 // accumulators far from their 2^30 carry bound at default chunk sizes.
@@ -248,7 +254,7 @@ void emit_memops(int32_t type, const void* p, size_t len) {
   int64_t lines = (int64_t)((a1 - a0) / g_line) + 1;
   if (lines > g_memop_max_lines) lines = g_memop_max_lines;
   for (int64_t i = 0; i < lines; i++) {
-    int32_t addr = (int32_t)((a0 + i * g_line) & ADDR_MASK);
+    int32_t addr = (int32_t)((((a0 + i * g_line)) / (uintptr_t)g_line) & ADDR_MASK);
     emit(type, g_line, addr);
   }
 }
@@ -313,9 +319,12 @@ void write_trace() {
     fprintf(stderr, "ptpu_capture: cannot open %s\n", path);
     return;
   }
-  uint32_t hdr[4] = {PTPU_MAGIC, PTPU_VERSION, (uint32_t)n_cores,
-                     (uint32_t)max_len};
-  fwrite(hdr, sizeof(uint32_t), 4, f);
+  uint32_t line_bits = 0;
+  for (int l = g_line; l > 1; l >>= 1) line_bits++;
+  uint32_t hdr[5] = {PTPU_MAGIC, PTPU_VERSION, (uint32_t)n_cores,
+                     (uint32_t)max_len,
+                     FLAG_LINE_ADDRESSED | (line_bits << 8)};
+  fwrite(hdr, sizeof(uint32_t), 5, f);
   for (int c = 0; c < n_cores; c++) {
     uint32_t len = (uint32_t)(g_threads[c].n + 1);
     fwrite(&len, sizeof(uint32_t), 1, f);
@@ -406,7 +415,7 @@ int pthread_mutex_lock(pthread_mutex_t* m) {
   if (!real_mutex_lock) resolve(real_mutex_lock, "pthread_mutex_lock");
   if (t_core >= 0 && !t_in_shim) {
     t_in_shim = true;
-    emit(EV_LOCK, 0, (int32_t)((uintptr_t)m & ADDR_MASK));
+    emit(EV_LOCK, 0, (int32_t)(((uintptr_t)m / (uintptr_t)g_line) & ADDR_MASK));
     t_in_shim = false;
   }
   return real_mutex_lock(m);
@@ -418,7 +427,7 @@ int pthread_mutex_trylock(pthread_mutex_t* m) {
   int r = real_mutex_trylock(m);
   if (r == 0 && t_core >= 0 && !t_in_shim) {
     t_in_shim = true;
-    emit(EV_LOCK, 0, (int32_t)((uintptr_t)m & ADDR_MASK));
+    emit(EV_LOCK, 0, (int32_t)(((uintptr_t)m / (uintptr_t)g_line) & ADDR_MASK));
     t_in_shim = false;
   }
   return r;
@@ -428,7 +437,7 @@ int pthread_mutex_unlock(pthread_mutex_t* m) {
   if (!real_mutex_unlock) resolve(real_mutex_unlock, "pthread_mutex_unlock");
   if (t_core >= 0 && !t_in_shim) {
     t_in_shim = true;
-    emit(EV_UNLOCK, 0, (int32_t)((uintptr_t)m & ADDR_MASK));
+    emit(EV_UNLOCK, 0, (int32_t)(((uintptr_t)m / (uintptr_t)g_line) & ADDR_MASK));
     t_in_shim = false;
   }
   return real_mutex_unlock(m);

@@ -39,6 +39,9 @@ class GoldenSim:
         assert trace.n_cores == cfg.n_cores
         self.cfg = cfg
         self.trace = trace
+        # internal addressing is LINE-granular (same normalization as the
+        # engine: byte traces shift at ingest, v4 line traces pass through)
+        self.events = trace.line_events(cfg.line_bits)
         C, B = cfg.n_cores, cfg.n_banks
         l1s, l1w = cfg.l1.sets, cfg.l1.ways
         ls, lw = cfg.llc.sets, cfg.llc.ways
@@ -72,9 +75,6 @@ class GoldenSim:
 
     # ------------------------------------------------------------ helpers
 
-    def _line(self, addr: int) -> int:
-        return addr >> self.cfg.line_bits
-
     def _bank(self, line: int) -> int:
         return line % self.cfg.n_banks
 
@@ -99,11 +99,12 @@ class GoldenSim:
     def _clear_sharers(self, b, s, w):
         self.sharers[b, s, w, :] = 0
 
-    def _lock_slot(self, addr: int) -> int:
-        return (addr >> self.cfg.line_bits) & (self.cfg.lock_slots - 1)
+    def _lock_slot(self, line: int) -> int:
+        """Mutex LINE index -> lock-table slot (events are line-granular)."""
+        return line & (self.cfg.lock_slots - 1)
 
-    def _lock_home_tile(self, addr: int) -> int:
-        return bank_tile(self._bank(self._line(addr)), self.cfg)
+    def _lock_home_tile(self, line: int) -> int:
+        return bank_tile(self._bank(line), self.cfg)
 
     def _noc(self, c: int, tile_a: int, tile_b: int):
         """Charge one message tile_a->tile_b to core c's NoC counters."""
@@ -124,7 +125,7 @@ class GoldenSim:
     # --------------------------------------------------------------- step
 
     def done(self) -> bool:
-        t = self.trace.events
+        t = self.events
         return all(
             t[c, min(int(self.ptr[c]), self.trace.max_len - 1), 0] == EV_END
             for c in range(self.cfg.n_cores)
@@ -133,7 +134,7 @@ class GoldenSim:
     def step(self) -> None:
         cfg = self.cfg
         C = cfg.n_cores
-        ev = self.trace.events
+        ev = self.events
 
         # --- quantum barrier (DESIGN.md §3): bump quantum_end if nobody
         # active. Barrier-frozen cores neither bump nor bound the quantum.
@@ -186,7 +187,7 @@ class GoldenSim:
                     continue
                 if t not in (EV_LD, EV_ST):
                     break  # sync events are never local: arbitrate below
-                line = self._line(addr)
+                line = addr  # line-granular events
                 s = self._l1_set(line)
                 w = -1
                 for wy in range(cfg.l1.ways):
@@ -258,7 +259,7 @@ class GoldenSim:
             if t == EV_BARRIER:
                 barrier_arr.append((c, addr, arg, pre))
                 continue
-            line = self._line(addr)
+            line = addr  # line-granular events
             s = self._l1_set(line)
             w = -1
             for wy in range(cfg.l1.ways):

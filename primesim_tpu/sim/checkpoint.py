@@ -32,6 +32,11 @@ def trace_fingerprint(trace) -> str:
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(trace.events).tobytes())
     h.update(np.ascontiguousarray(trace.lengths).tobytes())
+    # addressing interpretation is part of the workload identity: the same
+    # raw arrays read as byte- vs line-addressed are different workloads
+    h.update(
+        f"line_addressed={trace.line_addressed},{trace.line_bits}".encode()
+    )
     return h.hexdigest()
 
 

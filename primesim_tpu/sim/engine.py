@@ -217,7 +217,7 @@ def step(
         etr, eargr, eaddrr, eprer = evr[:, 0], evr[:, 1], evr[:, 2], evr[:, 3]
         can = run & (etr != EV_END) & (cycles_c < quantum_end)
         is_ins_r = can & (etr == EV_INS)
-        line_r = eaddrr >> cfg.line_bits
+        line_r = eaddrr  # ingest is line-granular (Trace.line_events)
         hit_any_r, hit_state_r, hit_col_r = _l1_probe_hit(
             cfg, arange_c, st.l1_tag, l1_state_c, st.l1_ptr, st.llc_tag,
             st.llc_owner, st.sharers, line_r,
@@ -265,7 +265,10 @@ def step(
     is_barrier = active & (et == EV_BARRIER)  # arrivals (frozen excluded)
 
     # ---- phase 1: L1 lookup + classification (post-run state) ------------
-    line = eaddr >> cfg.line_bits  # [C] int32 (addresses < 2^31)
+    # addresses arrive LINE-granular (Trace.line_events normalizes byte
+    # traces at ingest; v4 line-addressed traces pass through) — 2^31
+    # lines = 128 GiB at 64B lines, 64x the byte-addressed range
+    line = eaddr  # [C] int32 line index
     l1s = line & (S1 - 1)
     w1cols, tag_rows, weff = _l1_probe(
         cfg, arange_c, st.l1_tag, l1_state_c, st.l1_ptr, st.llc_tag,
@@ -297,8 +300,10 @@ def step(
     sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]
     shw = jnp.take_along_axis(sh_rows, llc_hway[:, None, None], axis=1)[:, 0]
 
-    # unpack sharer bits into a [winner, target] matrix — elementwise bit
-    # unpack + reshape, NOT a [C,C] element gather (TPU gathers are slow)
+    # sharer-set predicates from the PACKED words — popcount minus the
+    # self bit needs no [C, C] expansion (the expansion, when needed for
+    # invalidation targets, happens in phase 3: dense or chunked per
+    # cfg.sharer_chunk_words)
     word_idx = arange_c // 32  # [C] target -> word
     bit_idx = (arange_c % 32).astype(jnp.uint32)
 
@@ -306,9 +311,11 @@ def step(
         b = (words[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]) & 1
         return b.reshape(C, NW * 32)[:, :C] != 0
 
-    sh_bits = unpack_bits(shw)
-    sh_bits = sh_bits & (arange_c[None, :] != arange_c[:, None])  # exclude self
-    other_sharers = jnp.any(sh_bits, axis=1)
+    self_bit = ((shw[arange_c, word_idx] >> bit_idx) & 1).astype(jnp.int32)
+    total_sharers = jnp.sum(
+        jax.lax.population_count(shw), axis=1
+    ).astype(jnp.int32)
+    other_sharers = (total_sharers - self_bit) > 0
 
     # ---- phase 2: read-join coalescing + per-(bank,set) arbitration ------
     # GETS to an LLC-resident, ownerless, already-shared line may coalesce:
@@ -382,10 +389,6 @@ def step(
     llc_hit = llc_has & winner
     llc_miss = winner & ~llc_has
 
-    # per-pair round-trip latency/hops from home bank to target core
-    ttile = arange_c % n_tiles  # target tiles
-    pair_lat, pair_hops = _one_way(btile[:, None], ttile[None, :], cfg)
-
     has_owner = llc_hit & (owner >= 0) & (owner != arange_c)
     oclamp = jnp.maximum(owner, 0)
     otile = oclamp % n_tiles
@@ -395,17 +398,11 @@ def step(
     gets_w = gets & winner
     write_w = is_write_req & winner
 
-    # --- GETS grant decision
-    other_sharers = jnp.any(sh_bits, axis=1)
+    # --- GETS grant decision (other_sharers from the phase-1 popcount)
     gets_probe = gets_w & llc_hit & has_owner
     gets_shared = gets_w & llc_hit & ~has_owner & other_sharers
     gets_excl_hit = gets_w & llc_hit & ~has_owner & ~other_sharers
 
-    # --- write path: invalidations to recorded sharers (LLC hit only)
-    inv_pairs = sh_bits & (write_w & llc_hit)[:, None]  # [C, C]
-    inv_lat = jnp.max(jnp.where(inv_pairs, 2 * pair_lat, 0), axis=1)
-    inv_count = jnp.sum(inv_pairs, axis=1).astype(jnp.int32)
-    inv_hops = jnp.sum(jnp.where(inv_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
     write_probe = write_w & llc_hit & has_owner
 
     # --- LLC miss: victim + back-invalidation
@@ -417,13 +414,76 @@ def step(
     vic_owner = st.llc_owner[bank, bset, llc_vway]
     vic_shw = jnp.take_along_axis(sh_rows, llc_vway[:, None, None], axis=1)[:, 0]
     vic_valid = llc_miss & (vic_tag != -1)
-    vic_sh_bits = unpack_bits(vic_shw)
-    # back-inv targets: recorded sharers plus the owner (golden adds owner
-    # to vtargets when not already recorded as a sharer)
-    vic_owner_bit = (arange_c[None, :] == vic_owner[:, None]) & (vic_owner >= 0)[:, None]
-    back_pairs = (vic_sh_bits | vic_owner_bit) & vic_valid[:, None]
-    back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)
-    back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
+
+    # --- invalidation + back-invalidation target reductions. Targets come
+    # from the packed sharer words (write invalidations to the accessed
+    # line's sharers excluding self; back-invalidations to the victim's
+    # sharers PLUS its owner — golden adds the owner to vtargets when not
+    # already recorded). The reduction is either the dense [C, C]
+    # expansion (fastest at <= 1024 cores) or a lax.scan over K-word
+    # blocks bounding temporaries to [C, 32K] (cfg.sharer_chunk_words;
+    # BASELINE rungs 4-5). Bit-exact either way.
+    inv_row = write_w & llc_hit
+    if cfg.sharer_chunk_words:
+        K = cfg.sharer_chunk_words
+        nblk = NW // K
+        bit5 = jnp.arange(32, dtype=jnp.uint32)
+
+        def _blk(carry, b):
+            il, ic, ih, bc, bh = carry
+            off = b * K
+            sw = jax.lax.dynamic_slice_in_dim(shw, off, K, axis=1)
+            vw = jax.lax.dynamic_slice_in_dim(vic_shw, off, K, axis=1)
+            tt = off * 32 + jnp.arange(K * 32, dtype=jnp.int32)  # target ids
+            tvalid = tt[None, :] < C  # padding bits beyond core C-1
+            bits = (
+                ((sw[:, :, None] >> bit5[None, None, :]) & 1).reshape(C, K * 32)
+                != 0
+            )
+            vbits = (
+                ((vw[:, :, None] >> bit5[None, None, :]) & 1).reshape(C, K * 32)
+                != 0
+            )
+            plat, phops = _one_way(
+                btile[:, None], (tt % n_tiles)[None, :], cfg
+            )
+            sh_b = (
+                bits
+                & (tt[None, :] != arange_c[:, None])
+                & inv_row[:, None]
+                & tvalid
+            )
+            il = jnp.maximum(il, jnp.max(jnp.where(sh_b, 2 * plat, 0), axis=1))
+            ic = ic + jnp.sum(sh_b, axis=1).astype(jnp.int32)
+            ih = ih + jnp.sum(jnp.where(sh_b, 2 * phops, 0), axis=1).astype(
+                jnp.int32
+            )
+            ob = (tt[None, :] == vic_owner[:, None]) & (vic_owner >= 0)[:, None]
+            bk_b = (vbits | ob) & vic_valid[:, None] & tvalid
+            bc = bc + jnp.sum(bk_b, axis=1).astype(jnp.int32)
+            bh = bh + jnp.sum(jnp.where(bk_b, 2 * phops, 0), axis=1).astype(
+                jnp.int32
+            )
+            return (il, ic, ih, bc, bh), None
+
+        z5 = jnp.zeros(C, jnp.int32)
+        (inv_lat, inv_count, inv_hops, back_count, back_hops), _ = jax.lax.scan(
+            _blk, (z5, z5, z5, z5, z5), jnp.arange(nblk, dtype=jnp.int32)
+        )
+    else:
+        ttile = arange_c % n_tiles  # target tiles
+        pair_lat, pair_hops = _one_way(btile[:, None], ttile[None, :], cfg)
+        sh_bits = unpack_bits(shw)
+        sh_bits = sh_bits & (arange_c[None, :] != arange_c[:, None])
+        inv_pairs = sh_bits & inv_row[:, None]  # [C, C]
+        inv_lat = jnp.max(jnp.where(inv_pairs, 2 * pair_lat, 0), axis=1)
+        inv_count = jnp.sum(inv_pairs, axis=1).astype(jnp.int32)
+        inv_hops = jnp.sum(jnp.where(inv_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
+        vic_sh_bits = unpack_bits(vic_shw)
+        vic_owner_bit = (arange_c[None, :] == vic_owner[:, None]) & (vic_owner >= 0)[:, None]
+        back_pairs = (vic_sh_bits | vic_owner_bit) & vic_valid[:, None]
+        back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)
+        back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
 
     # --- latency composition (golden order)
     probe_any = gets_probe | write_probe
@@ -878,7 +938,7 @@ class Engine:
         self.has_sync = bool(
             ((t == EV_LOCK) | (t == EV_UNLOCK) | (t == EV_BARRIER)).any()
         )
-        self.events = jnp.asarray(trace.events)
+        self.events = jnp.asarray(trace.line_events(cfg.line_bits))
         self.state = init_state(cfg)
         self.mesh = mesh
         if mesh is not None:

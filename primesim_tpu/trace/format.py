@@ -17,9 +17,11 @@ the engine's unit of wall-clock cost.
 
 Binary file layout (little-endian):
     magic   uint32  0x50545055  ("PTPU")
-    version uint32  3   (v1: 3-field records, pre=0; v2: no sync events)
+    version uint32  4   (v1: 3-field records, pre=0; v2: no sync events;
+                         v3: no flags word)
     n_cores uint32
     max_len uint32  (padded per-core event count)
+    flags   uint32  (v4+ only; bit 0 = line-addressed)
     lengths uint32[n_cores]  (true event count per core, <= max_len)
     events  int32[n_cores, max_len, 4]   (type, arg, addr, pre)
 
@@ -31,6 +33,17 @@ frontend captures by intercepting pthread_mutex/barrier calls (SURVEY.md
 lock-table slot by the engines), BARRIER carries a dense barrier id in
 `addr` and the participant count in `arg`. All three use `pre` like
 memory events. Timing/blocking semantics are DESIGN.md §3-sync.
+
+v4 adds the `flags` header word. Flag bit 0 (`line_addressed`): the
+`addr` field of LD/ST/LOCK/UNLOCK events holds a cache-LINE index, not a
+byte address — widening the addressable range 64x, from 2^31 bytes (2
+GiB) to 2^31 lines (128 GiB at 64-byte lines). Larger captured address
+spaces still alias (the frontend masks line indices to 31 bits); a
+2x32-bit record extension remains the path to fully un-aliased 48-bit
+spaces. Flags bits 8-15 record log2(line size) at capture time; engines
+reject line-addressed traces whose line size differs from the machine
+config. Both engines normalize ingest to line granularity, so byte- and
+line-addressed encodings of one workload simulate identically.
 """
 
 from __future__ import annotations
@@ -38,7 +51,8 @@ from __future__ import annotations
 import numpy as np
 
 MAGIC = 0x50545055
-VERSION = 3
+VERSION = 4
+FLAG_LINE_ADDRESSED = 1
 
 # Event types (DESIGN.md §2)
 EV_INS = 0  # batch of non-memory instructions; arg = count
@@ -55,20 +69,31 @@ SYNC_TYPES = (EV_LOCK, EV_UNLOCK, EV_BARRIER)
 
 class Trace:
     """Per-core event arrays: events[n_cores, max_len, 4] int32 records
-    (type, arg, addr, pre)."""
+    (type, arg, addr, pre). With `line_addressed`, LD/ST/LOCK/UNLOCK addr
+    fields hold cache-line indices instead of byte addresses (v4 flag)."""
 
-    def __init__(self, events: np.ndarray, lengths: np.ndarray):
+    def __init__(
+        self,
+        events: np.ndarray,
+        lengths: np.ndarray,
+        line_addressed: bool = False,
+        line_bits: int | None = None,
+    ):
         events = np.asarray(events, dtype=np.int32)
         lengths = np.asarray(lengths, dtype=np.int32)
         assert events.ndim == 3 and events.shape[2] == N_FIELDS
         assert lengths.shape == (events.shape[0],)
+        self.line_addressed = bool(line_addressed)
+        # line size (log2) the line indices were derived with; None =
+        # unknown/not applicable (byte-addressed traces)
+        self.line_bits = line_bits if line_addressed else None
         t = events[:, :, 0]
         if t.size:
             if not ((t >= EV_INS) & (t <= EV_BARRIER)).all():
                 raise ValueError("trace contains invalid event types")
             mem = (t == EV_LD) | (t == EV_ST) | (t == EV_LOCK) | (t == EV_UNLOCK)
             if (events[:, :, 2][mem] < 0).any():
-                raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
+                raise ValueError("addresses must be in [0, 2^31) (31-bit)")
             if (events[:, :, 1][t == EV_INS] < 0).any():
                 raise ValueError("INS batch counts must be >= 0")
             bar = t == EV_BARRIER
@@ -104,12 +129,35 @@ class Trace:
         pre = np.where(op_mask, self.events[:, :, 3], 0).astype(np.int64).sum()
         return int(ins) + int(pre) + int(op_mask.sum())
 
+    def line_events(self, line_bits: int) -> np.ndarray:
+        """Events normalized to LINE-granular addresses (the engines'
+        internal form): LD/ST/LOCK/UNLOCK addr fields become line indices;
+        barrier ids and all other fields pass through. Line-addressed
+        traces return the SHARED events array (engines never mutate it);
+        their recorded line size must match the machine's."""
+        if self.line_addressed:
+            if self.line_bits is not None and self.line_bits != line_bits:
+                raise ValueError(
+                    f"trace was captured with {1 << self.line_bits}-byte "
+                    f"lines but the machine uses {1 << line_bits}-byte lines"
+                )
+            return self.events
+        ev = self.events.copy()
+        t = ev[:, :, 0]
+        addr_ev = (t == EV_LD) | (t == EV_ST) | (t == EV_LOCK) | (t == EV_UNLOCK)
+        ev[:, :, 2] = np.where(addr_ev, ev[:, :, 2] >> line_bits, ev[:, :, 2])
+        return ev
+
     # ---------------------------------------------------------------- I/O
 
     def save(self, path: str) -> None:
         with open(path, "wb") as f:
             hdr = np.array([MAGIC, VERSION, self.n_cores, self.max_len], dtype="<u4")
             hdr.tofile(f)
+            fl = FLAG_LINE_ADDRESSED if self.line_addressed else 0
+            if self.line_addressed and self.line_bits is not None:
+                fl |= (self.line_bits & 0xFF) << 8
+            np.array([fl], dtype="<u4").tofile(f)
             self.lengths.astype("<u4").tofile(f)
             self.events.astype("<i4").tofile(f)
 
@@ -119,9 +167,15 @@ class Trace:
             hdr = np.fromfile(f, dtype="<u4", count=4)
             if hdr.shape[0] != 4 or hdr[0] != MAGIC:
                 raise ValueError(f"{path}: not a primesim_tpu trace file")
-            if hdr[1] not in (1, 2, 3):
+            if hdr[1] not in (1, 2, 3, 4):
                 raise ValueError(f"{path}: unsupported trace version {hdr[1]}")
             nf = 3 if hdr[1] == 1 else N_FIELDS
+            flags = 0
+            if hdr[1] >= 4:
+                fw = np.fromfile(f, dtype="<u4", count=1)
+                if fw.shape[0] != 1:
+                    raise ValueError(f"{path}: truncated trace file")
+                flags = int(fw[0])
             n_cores, max_len = int(hdr[2]), int(hdr[3])
             lengths = np.fromfile(f, dtype="<u4", count=n_cores).astype(np.int32)
             events = np.fromfile(f, dtype="<i4", count=n_cores * max_len * nf)
@@ -132,7 +186,13 @@ class Trace:
                 events = np.concatenate(
                     [events, np.zeros((n_cores, max_len, 1), np.int32)], axis=2
                 )
-        return Trace(events, lengths)
+        lb = (flags >> 8) & 0xFF
+        return Trace(
+            events,
+            lengths,
+            line_addressed=bool(flags & FLAG_LINE_ADDRESSED),
+            line_bits=lb if lb else None,
+        )
 
 
 def validate_sync(trace: Trace, barrier_slots: int) -> None:
@@ -148,7 +208,9 @@ def validate_sync(trace: Trace, barrier_slots: int) -> None:
         )
 
 
-def from_event_lists(per_core: list[list[tuple]]) -> Trace:
+def from_event_lists(
+    per_core: list[list[tuple]], line_addressed: bool = False
+) -> Trace:
     """Build a padded Trace from python per-core event lists.
 
     Each event is (type, arg, addr) or (type, arg, addr, pre); pre defaults
@@ -169,11 +231,11 @@ def from_event_lists(per_core: list[list[tuple]]) -> Trace:
             e[:, 0] = arr[:, 0].astype(np.int32)
             e[:, 1] = arr[:, 1].astype(np.int32)
             if (arr[:, 2] < 0).any() or (arr[:, 2] >= 2**31).any():
-                raise ValueError("v1 addresses must be in [0, 2^31) (31-bit)")
+                raise ValueError("addresses must be in [0, 2^31) (31-bit)")
             e[:, 2] = arr[:, 2].astype(np.int32)
             e[:, 3] = arr[:, 3].astype(np.int32)
             events[c, : len(evs)] = e
-    return Trace(events, lengths)
+    return Trace(events, lengths, line_addressed=line_addressed)
 
 
 def fold_ins(trace: Trace) -> Trace:
@@ -203,4 +265,4 @@ def fold_ins(trace: Trace) -> Trace:
         if acc:
             evs.append((EV_INS, acc, 0))
         out.append(evs)
-    return from_event_lists(out)
+    return from_event_lists(out, line_addressed=trace.line_addressed)

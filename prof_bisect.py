@@ -37,22 +37,22 @@ VARIANTS = {
          "llc_owner_n = st.llc_owner"),
     ],
     "no_unpack_CC": [
-        ("    sh_bits = unpack_bits(shw)",
-         "    sh_bits = jnp.zeros((C, C), bool)"),
-        ("    vic_sh_bits = unpack_bits(vic_shw)",
-         "    vic_sh_bits = jnp.zeros((C, C), bool)"),
+        ("        sh_bits = unpack_bits(shw)",
+         "        sh_bits = jnp.zeros((C, C), bool)"),
+        ("        vic_sh_bits = unpack_bits(vic_shw)",
+         "        vic_sh_bits = jnp.zeros((C, C), bool)"),
     ],
     "no_CC_reductions": [
-        ("    inv_lat = jnp.max(jnp.where(inv_pairs, 2 * pair_lat, 0), axis=1)",
-         "    inv_lat = jnp.zeros(C, jnp.int32)"),
-        ("    inv_count = jnp.sum(inv_pairs, axis=1).astype(jnp.int32)",
-         "    inv_count = jnp.zeros(C, jnp.int32)"),
-        ("    inv_hops = jnp.sum(jnp.where(inv_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
-         "    inv_hops = jnp.zeros(C, jnp.int32)"),
-        ("    back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)",
-         "    back_count = jnp.zeros(C, jnp.int32)"),
-        ("    back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
-         "    back_hops = jnp.zeros(C, jnp.int32)"),
+        ("        inv_lat = jnp.max(jnp.where(inv_pairs, 2 * pair_lat, 0), axis=1)",
+         "        inv_lat = jnp.zeros(C, jnp.int32)"),
+        ("        inv_count = jnp.sum(inv_pairs, axis=1).astype(jnp.int32)",
+         "        inv_count = jnp.zeros(C, jnp.int32)"),
+        ("        inv_hops = jnp.sum(jnp.where(inv_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
+         "        inv_hops = jnp.zeros(C, jnp.int32)"),
+        ("        back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)",
+         "        back_count = jnp.zeros(C, jnp.int32)"),
+        ("        back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
+         "        back_hops = jnp.zeros(C, jnp.int32)"),
     ],
     "no_arb_table": [
         ('    table = table.at[jnp.where(req, slot, B * S2)].min(key, mode="drop")',
@@ -117,7 +117,7 @@ def main():
         noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
         dram_lat=100, quantum=1000)
     trace = fold_ins(synth.fft_like(C, n_phases=2, points_per_core=16, ins_per_mem=8, seed=42))
-    events = jnp.asarray(trace.events)
+    events = jnp.asarray(trace.line_events(cfg.line_bits))
     n = 256
     base = None
     for name in VARIANTS:

@@ -27,7 +27,7 @@ def bench_cfg(C=1024, llc_kb=256):
 def time_chunk(cfg, n_steps=256, tag=""):
     trace = fold_ins(synth.fft_like(cfg.n_cores, n_phases=4, points_per_core=256,
                                     ins_per_mem=8, seed=42))
-    events = jnp.asarray(trace.events)
+    events = jnp.asarray(trace.line_events(cfg.line_bits))
     st = init_state(cfg)
     # NOTE: sync via an explicit host transfer (np.asarray of a leaf).
     # jax.block_until_ready on AOT-compiled outputs under-synced through
