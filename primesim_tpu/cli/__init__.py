@@ -96,21 +96,33 @@ def cmd_run(ns) -> int:
 
         import jax.numpy as jnp
 
-        from ..sim.engine import Engine, run_loop
+        from ..sim.engine import Engine, run_chunk, run_loop
 
         # warm the jit cache at the measured shapes (one chunk) so the
         # reported MIPS measures simulation, not compilation — the same
         # protocol as bench.py; comparable numbers matter more than the
-        # one-off compile cost shown to an interactive user
+        # one-off compile cost shown to an interactive user. The debug
+        # path dispatches run_chunk, not the fused run_loop — warm the
+        # function the run will actually use.
         warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
-        out = run_loop(
-            cfg, ns.chunk_steps, warm.events, warm.state,
-            jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
-        )
-        np.asarray(out[0].cycles)  # block until compiled + run
+        if ns.debug_invariants:
+            out = run_chunk(
+                cfg, ns.chunk_steps, warm.events, warm.state,
+                has_sync=warm.has_sync,
+            )
+            np.asarray(out.cycles)  # block until compiled + run
+        else:
+            out = run_loop(
+                cfg, ns.chunk_steps, warm.events, warm.state,
+                jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+            )
+            np.asarray(out[0].cycles)
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
         t0 = time.perf_counter()
-        eng.run(max_steps=ns.max_steps)
+        if ns.debug_invariants:
+            eng.run_chunked(max_steps=ns.max_steps, debug_invariants=True)
+        else:
+            eng.run(max_steps=ns.max_steps)
         wall = time.perf_counter() - t0
         cycles, counters = eng.cycles, eng.counters
 
@@ -173,6 +185,11 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--max-steps", type=int, default=10_000_000)
     r.add_argument("--report", help="write text report to this path")
     r.add_argument("--per-core-limit", type=int, default=64)
+    r.add_argument(
+        "--debug-invariants", action="store_true",
+        help="check DESIGN.md machine invariants after every chunk "
+             "(jax engine; slower, chunked dispatch)",
+    )
     r.set_defaults(fn=cmd_run)
 
     s = sub.add_parser("synth", help="generate a synthetic PTPU trace file")

@@ -1037,18 +1037,21 @@ class Engine:
         if not self.done():
             raise RuntimeError("engine: max_steps exceeded (deadlock?)")
 
-    def run_chunked(self, max_steps: int = 10_000_000) -> None:
+    def run_chunked(
+        self, max_steps: int = 10_000_000, debug_invariants: bool = False
+    ) -> None:
         """Host-loop variant: one dispatch per chunk + host drain/rebase.
 
         Semantically identical to `run()`; kept for debugging (state is
         inspectable between chunks) and as the reference for the fused
-        loop's on-device bookkeeping.
+        loop's on-device bookkeeping. `debug_invariants` checks the
+        DESIGN.md §5 machine invariants after every chunk.
         """
-        self.run_steps(max_steps - self.steps_run)
+        self.run_steps(max_steps - self.steps_run, debug_invariants)
         if not self.done():
             raise RuntimeError("engine: max_steps exceeded (deadlock?)")
 
-    def run_steps(self, n_steps: int) -> None:
+    def run_steps(self, n_steps: int, debug_invariants: bool = False) -> None:
         """Advance exactly `n_steps` (rounded up to whole chunks) WITHOUT
         the completion check — the building block for checkpointed runs:
         run_steps(A) -> save_checkpoint -> (later) load_checkpoint ->
@@ -1062,6 +1065,17 @@ class Engine:
             self.steps_run += self.chunk_steps
             self._drain()
             self._rebase()
+            if debug_invariants:
+                self.verify_invariants()
+
+    def verify_invariants(self) -> None:
+        """Check the DESIGN.md §5 machine invariants on the current state
+        (host-side; raises AssertionError naming the violation)."""
+        from .validate import check_invariants
+
+        check_invariants(
+            self.cfg, self.state, done_mask=self._event_types_at_ptr() == EV_END
+        )
 
     # ---- checkpoint / resume (SURVEY.md §5.4) ----------------------------
 

@@ -68,3 +68,103 @@ def effective_l1_state(
         I,
         np.where(owner == cores, l1_state, np.where(shbit, S, I)),
     ).astype(l1_state.dtype)
+
+
+def check_invariants(cfg: MachineConfig, state, done_mask=None) -> None:
+    """DESIGN.md §5 debug invariants, checked host-side on a MachineState.
+
+    Raises AssertionError naming the violated invariant. Cheap enough to
+    run between chunks (`Engine.run_chunked(debug_invariants=True)`,
+    `primetpu run --debug-invariants`); the randomized MESI property tests
+    (tests/test_invariants.py) drive it over adversarial request streams.
+
+    `done_mask` ([C] bool) marks finished cores: their epoch-relative
+    clocks legitimately go negative once rebases (which track only LIVE
+    cores) outrun them — the true clock is `cycles + cycle_base`. Without
+    the mask the clock invariant is skipped.
+    """
+    def _require(cond, msg):
+        if not cond:
+            raise AssertionError(msg)
+
+    C = cfg.n_cores
+    l1_tag = np.asarray(state.l1_tag)
+    l1_state = np.asarray(state.l1_state)
+    llc_tag = np.asarray(state.llc_tag)
+    llc_owner = np.asarray(state.llc_owner)
+    sharers = np.asarray(state.sharers)
+    B, S2, W2 = llc_tag.shape
+    NW = cfg.n_sharer_words
+
+    # 1. directory exclusivity: an owned entry records no sharers
+    sh3 = sharers.reshape(B * S2, W2, NW)
+    owned = (llc_owner >= 0).reshape(B * S2, W2)
+    _require(
+        not (owned & (sh3 != 0).any(-1)).any(),
+        "invariant: owned LLC entry has non-empty sharer set",
+    )
+
+    # 2. owner / sharer-bit ranges
+    _require(
+        ((llc_owner >= -1) & (llc_owner < C)).all(),
+        "invariant: llc_owner out of range",
+    )
+    if C % 32:
+        bits = (
+            (sh3[..., None] >> np.arange(32, dtype=np.uint32)) & 1
+        ).reshape(B * S2, W2, NW * 32)
+        _require(
+            not (bits[:, :, C:] != 0).any(),
+            "invariant: sharer bits set beyond core count",
+        )
+
+    # 3. valid LLC tags unique per (bank, set)
+    t2 = llc_tag.reshape(B * S2, W2)
+    for w in range(W2):
+        for w2 in range(w + 1, W2):
+            clash = (t2[:, w] != -1) & (t2[:, w] == t2[:, w2])
+            _require(not clash.any(), "invariant: duplicate valid LLC tag in set")
+
+    # 4. valid L1 tags unique per (core, set) — the fill path clears stale
+    # duplicates so a line never occupies two ways
+    gt = engine_l1_to_golden(cfg, l1_tag)  # [C, S1, W1]
+    W1 = gt.shape[2]
+    for w in range(W1):
+        for w2 in range(w + 1, W1):
+            clash = (gt[:, :, w] != -1) & (gt[:, :, w] == gt[:, :, w2])
+            _require(not clash.any(), "invariant: duplicate valid L1 tag in set")
+
+    # 5. effective E/M exclusivity: at most one core holds a line in E/M
+    eff = effective_l1_state(cfg, l1_tag, l1_state, llc_tag, llc_owner, sharers)
+    em = eff >= E
+    em_lines = gt[em]
+    _require(
+        len(np.unique(em_lines)) == len(em_lines),
+        "invariant: two cores hold the same line in E/M",
+    )
+
+    # 6. synchronization tables
+    lock_holder = np.asarray(state.lock_holder)
+    barrier_count = np.asarray(state.barrier_count)
+    barrier_time = np.asarray(state.barrier_time)
+    sync_flag = np.asarray(state.sync_flag)
+    _require(
+        ((lock_holder >= -1) & (lock_holder < C)).all(),
+        "invariant: lock_holder out of range",
+    )
+    _require((barrier_count >= 0).all(), "invariant: negative barrier count")
+    _require(
+        (barrier_time[barrier_count == 0] == 0).all(),
+        "invariant: stale barrier_time on empty slot",
+    )
+    _require(np.isin(sync_flag, (0, 1)).all(), "invariant: sync_flag not 0/1")
+
+    # 7. core bookkeeping
+    ptr = np.asarray(state.ptr)
+    _require((ptr >= 0).all(), "invariant: negative trace pointer")
+    if done_mask is not None:
+        live = ~np.asarray(done_mask)
+        _require(
+            (np.asarray(state.cycles)[live] >= 0).all(),
+            "invariant: negative (under-rebased) live core clock",
+        )
