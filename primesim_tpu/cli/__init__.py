@@ -84,6 +84,11 @@ def cmd_run(ns) -> int:
         )
 
     if ns.engine == "golden":
+        if ns.xprof or ns.debug_invariants:
+            raise SystemExit(
+                "--xprof/--debug-invariants require --engine jax "
+                "(the golden oracle has no device trace or chunk boundaries)"
+            )
         from ..golden.sim import GoldenSim
 
         t0 = time.perf_counter()
@@ -118,11 +123,22 @@ def cmd_run(ns) -> int:
             )
             np.asarray(out[0].cycles)
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
+
+        def _go():
+            if ns.debug_invariants:
+                eng.run_chunked(max_steps=ns.max_steps, debug_invariants=True)
+            else:
+                eng.run(max_steps=ns.max_steps)
+
         t0 = time.perf_counter()
-        if ns.debug_invariants:
-            eng.run_chunked(max_steps=ns.max_steps, debug_invariants=True)
+        if ns.xprof:
+            import jax
+
+            with jax.profiler.trace(ns.xprof):
+                _go()
+            print(f"profiler trace written to {ns.xprof}", file=sys.stderr)
         else:
-            eng.run(max_steps=ns.max_steps)
+            _go()
         wall = time.perf_counter() - t0
         cycles, counters = eng.cycles, eng.counters
 
@@ -189,6 +205,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--debug-invariants", action="store_true",
         help="check DESIGN.md machine invariants after every chunk "
              "(jax engine; slower, chunked dispatch)",
+    )
+    r.add_argument(
+        "--xprof",
+        help="write a JAX profiler trace of the run to this directory "
+             "(jax engine; inspect with xprof/tensorboard)",
     )
     r.set_defaults(fn=cmd_run)
 

@@ -93,15 +93,24 @@ class CoreConfig:
 class NocConfig:
     """2-D mesh NoC (SURVEY.md §2 #6: Network, XY routing, hop-by-hop).
 
-    `contention=True` enables the router-occupancy queueing model: every
-    uncore transaction served at a home tile in the same step (memory
-    winners + read-joins at their home bank, lock/unlock RMWs at the
-    lock's home, barrier arrivals at the barrier's home) queues behind
-    the others — each is charged `contention_lat * (n_at_tile - 1)` extra
-    cycles, making hot-bank latency load-dependent (BASELINE rung 3
-    "NoC-congestion heavy"). Identical in both engines; charged before
-    the O3 overlap reduction. Hop-by-hop per-link routing stays the
-    planned Pallas v2.
+    `contention=True` enables load-dependent queueing, in one of two
+    models (`contention_model`):
+
+    - ``"tile"`` — router occupancy at the HOME tile: every uncore
+      transaction served at a tile in the same step (memory winners +
+      read-joins at their home bank, lock/unlock RMWs at the lock's home,
+      barrier arrivals at the barrier's home) queues behind the others;
+      each is charged `contention_lat * (n_at_tile - 1)` extra cycles.
+    - ``"link"`` — hop-by-hop per-LINK occupancy: each transaction's XY
+      request+reply paths (barrier arrivals: the one-way arrival path)
+      claim every directed mesh link they traverse; the charge is
+      `contention_lat * max over the path of (link_occupancy - 1)` — the
+      bottleneck-link queue. This makes path-crossing traffic contend
+      even when home banks differ (BASELINE rung 3 "NoC-congestion
+      heavy").
+
+    Both models are implemented identically in the golden and JAX engines
+    and charged before the O3 overlap reduction.
     """
 
     mesh_x: int = 8
@@ -109,6 +118,7 @@ class NocConfig:
     link_lat: int = 1  # per-hop link traversal, cycles
     router_lat: int = 1  # per-router, cycles ((hops+1) routers on a path)
     contention: bool = False
+    contention_model: str = "tile"  # "tile" | "link"
     contention_lat: int = 1  # queueing cycles per concurrent transaction
 
     @property
@@ -170,6 +180,8 @@ class MachineConfig:
             raise ValueError("NoC latencies must be >= 0")
         if self.noc.contention_lat < 0:
             raise ValueError("contention_lat must be >= 0")
+        if self.noc.contention_model not in ("tile", "link"):
+            raise ValueError("contention_model must be 'tile' or 'link'")
         if self.noc.mesh_x < 1 or self.noc.mesh_y < 1:
             raise ValueError("mesh dims must be >= 1")
         if not (0 <= self.local_run_len <= 64):

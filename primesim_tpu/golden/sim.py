@@ -17,7 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..config.machine import MachineConfig
-from ..noc.mesh import bank_tile, core_tile, hops as _hops, one_way_lat
+from ..noc.mesh import bank_tile, core_tile, hops as _hops, one_way_lat, xy_links
 from ..stats.counters import zero_counters
 from ..trace.format import (
     EV_BARRIER,
@@ -113,12 +113,30 @@ class GoldenSim:
         self.counters["noc_hops"][c] += _hops(tile_a, tile_b, self.cfg.noc.mesh_x)
         return lat
 
-    def _contention_extra(self, c: int, tile: int) -> int:
-        """Router-occupancy queueing charge for core c's transaction at
-        `tile` this step (0 when the model is disabled)."""
-        if not self.cfg.noc.contention:
+    def _txn_path(self, ctile: int, htile: int, round_trip: bool) -> list[int]:
+        mx = self.cfg.noc.mesh_x
+        p = xy_links(ctile, htile, mx)
+        if round_trip:
+            p = p + xy_links(htile, ctile, mx)
+        return p
+
+    def _contention_extra(
+        self, c: int, ctile: int, htile: int, round_trip: bool = True
+    ) -> int:
+        """Queueing charge for core c's transaction from `ctile` to home
+        `htile` this step (0 when the model is disabled). Tile model:
+        occupancy at the home tile; link model: bottleneck occupancy over
+        the transaction's XY path links."""
+        cfg = self.cfg
+        if not cfg.noc.contention:
             return 0
-        extra = self.cfg.noc.contention_lat * (self._tile_txns.get(tile, 1) - 1)
+        if cfg.noc.contention_model == "tile":
+            extra = cfg.noc.contention_lat * (self._tile_txns.get(htile, 1) - 1)
+        else:
+            worst = 0
+            for l in self._txn_path(ctile, htile, round_trip):
+                worst = max(worst, self._link_cnt.get(l, 1) - 1)
+            extra = cfg.noc.contention_lat * worst
         self.counters["noc_contention_cycles"][c] += extra
         return extra
 
@@ -318,28 +336,38 @@ class GoldenSim:
             for r in rs[1:]:
                 self.counters["retries"][r[1]] += 1
 
-        # --- router-occupancy contention counts (NocConfig.contention) ----
-        # Every uncore transaction served at a home tile this step queues
-        # behind the others there: memory winners + joins at their home
-        # bank tile, lock/unlock RMWs at the lock's home tile, barrier
-        # arrivals at the barrier's home tile. Counts are fixed BEFORE any
-        # charging so the extra is identical for every transaction at the
-        # tile (matching the engine's one-scatter count).
+        # --- contention occupancy counts (NocConfig.contention) -----------
+        # Tile model: every uncore transaction served at a home tile this
+        # step queues behind the others there. Link model: every directed
+        # mesh link on a transaction's XY request+reply path (barrier
+        # arrivals: one-way) is claimed by it. Counts are fixed BEFORE any
+        # charging so the extra is identical for every transaction sharing
+        # a tile/link (matching the engine's one-scatter count). The
+        # transaction classes: memory winners + joins (home bank),
+        # lock/unlock RMWs (lock home), barrier arrivals (barrier home).
         self._tile_txns = {}
+        self._link_cnt = {}
         if cfg.noc.contention:
-            def _bump(t):
-                self._tile_txns[t] = self._tile_txns.get(t, 0) + 1
+            link_model = cfg.noc.contention_model == "link"
 
-            for _, _, _, line, _ in winners:
-                _bump(bank_tile(self._bank(line), cfg))
-            for _, line, _ in join_go:
-                _bump(bank_tile(self._bank(line), cfg))
-            for _, addr, _ in unlocks:
-                _bump(self._lock_home_tile(addr))
-            for _, _, addr, _ in lock_reqs:
-                _bump(self._lock_home_tile(addr))
-            for _, bid, _, _ in barrier_arr:
-                _bump(bid % cfg.n_tiles)
+            def _bump(c, htile, round_trip=True):
+                if link_model:
+                    ctile = core_tile(c, cfg)
+                    for l in self._txn_path(ctile, htile, round_trip):
+                        self._link_cnt[l] = self._link_cnt.get(l, 0) + 1
+                else:
+                    self._tile_txns[htile] = self._tile_txns.get(htile, 0) + 1
+
+            for _, c, _, line, _ in winners:
+                _bump(c, bank_tile(self._bank(line), cfg))
+            for c, line, _ in join_go:
+                _bump(c, bank_tile(self._bank(line), cfg))
+            for c, addr, _ in unlocks:
+                _bump(c, self._lock_home_tile(addr))
+            for _, c, addr, _ in lock_reqs:
+                _bump(c, self._lock_home_tile(addr))
+            for c, bid, _, _ in barrier_arr:
+                _bump(c, bid % cfg.n_tiles, round_trip=False)
 
         for c, line, pre in join_go:
             self._do_join(c, line, pre, step)
@@ -471,7 +499,7 @@ class GoldenSim:
                     grant = M
 
             lat += self._noc(c, btile, ctile)  # reply
-            lat += self._contention_extra(c, btile)
+            lat += self._contention_extra(c, ctile, btile)
 
             # O3-style overlap: hide a fraction of the miss latency
             ov = cfg.core.o3_overlap_256
@@ -526,7 +554,7 @@ class GoldenSim:
             h = self._lock_home_tile(addr)
             ctile = core_tile(c, cfg)
             lat = self._noc(c, ctile, h) + cfg.llc.latency + self._noc(c, h, ctile)
-            lat += self._contention_extra(c, h)
+            lat += self._contention_extra(c, ctile, h)
             self.cycles[c] += pre * int(self.cpi[c]) + lat
             self.counters["instructions"][c] += pre + 1
             if self.lock_holder[s] == c:
@@ -547,7 +575,7 @@ class GoldenSim:
                     + cfg.llc.latency
                     + self._noc(c, h, ctile)
                 )
-                lat += self._contention_extra(c, h)
+                lat += self._contention_extra(c, ctile, h)
                 if self.sync_flag[c] == 0:  # first attempt: charge pre batch
                     self.cycles[c] += pre * int(self.cpi[c])
                     self.counters["instructions"][c] += pre
@@ -569,7 +597,7 @@ class GoldenSim:
             self.cycles[c] += pre * int(self.cpi[c])
             self.counters["instructions"][c] += pre
             self.cycles[c] += self._noc(c, ctile, h)  # arrival message
-            self.cycles[c] += self._contention_extra(c, h)
+            self.cycles[c] += self._contention_extra(c, ctile, h, round_trip=False)
             self.counters["barrier_waits"][c] += 1
             self.sync_flag[c] = 1
             self.barrier_count[bid] += 1
@@ -635,7 +663,7 @@ class GoldenSim:
         self._set_sharer(b, bs, w, c, True)
         self.llc_lru[b, bs, w] = step
         lat += self._noc(c, btile, ctile)
-        lat += self._contention_extra(c, btile)
+        lat += self._contention_extra(c, ctile, btile)
         ov = cfg.core.o3_overlap_256
         if ov:
             lat = lat - ((lat * ov) >> 8)

@@ -10,6 +10,8 @@ the planned v2 behind `NocConfig` gating.
 
 from __future__ import annotations
 
+import functools
+
 from ..config.machine import MachineConfig
 
 
@@ -35,3 +37,34 @@ def core_tile(core, cfg: MachineConfig):
 
 def bank_tile(bank, cfg: MachineConfig):
     return bank % cfg.n_tiles
+
+
+# Directed links for the per-link contention model: each tile sources four
+# links, id = tile*4 + dir with dir 0=E (+x), 1=W (-x), 2=N (+y), 3=S (-y).
+# XY routing uses x-phase links at the source row, then y-phase links at
+# the destination column — `xy_links` is the scalar reference walk the
+# vectorized engine path builder must match link-for-link.
+
+
+def n_links(cfg: MachineConfig) -> int:
+    return cfg.n_tiles * 4
+
+
+@functools.lru_cache(maxsize=None)
+def xy_links(a: int, b: int, mesh_x: int) -> tuple[int, ...]:
+    """Directed link ids on the XY route tile a -> tile b (scalar,
+    memoized — tile pairs repeat heavily across golden steps; immutable
+    so the cached value cannot be corrupted)."""
+    ax, ay = a % mesh_x, a // mesh_x
+    bx, by = b % mesh_x, b // mesh_x
+    links = []
+    x, y = ax, ay
+    while x != bx:
+        d = 0 if bx > x else 1
+        links.append((y * mesh_x + x) * 4 + d)
+        x += 1 if bx > x else -1
+    while y != by:
+        d = 2 if by > y else 3
+        links.append((y * mesh_x + x) * 4 + d)
+        y += 1 if by > y else -1
+    return tuple(links)

@@ -63,6 +63,30 @@ def _one_way(tile_a, tile_b, cfg: MachineConfig):
     return h * cfg.noc.link_lat + (h + 1) * cfg.noc.router_lat, h
 
 
+def _path_links(cfg: MachineConfig, a, b):
+    """Vectorized XY route a->b as directed link ids, -1-padded to the
+    mesh diameter — link-for-link identical to noc.mesh.xy_links (x phase
+    at the source row, then y phase at the destination column; link id =
+    tile*4 + dir with dir 0=E, 1=W, 2=N, 3=S)."""
+    mx, my = cfg.noc.mesh_x, cfg.noc.mesh_y
+    H = max(1, (mx - 1) + (my - 1))
+    ax, ay = a % mx, a // mx
+    bx, by = b % mx, b // mx
+    i = jnp.arange(H, dtype=jnp.int32)[None, :]
+    sx = jnp.sign(bx - ax)
+    nx = jnp.abs(bx - ax)
+    px = ax[:, None] + sx[:, None] * i
+    xlink = (ay[:, None] * mx + px) * 4 + jnp.where(sx[:, None] > 0, 0, 1)
+    sy = jnp.sign(by - ay)
+    ny = jnp.abs(by - ay)
+    j = i - nx[:, None]
+    py = ay[:, None] + sy[:, None] * j
+    ylink = (py * mx + bx[:, None]) * 4 + jnp.where(sy[:, None] > 0, 2, 3)
+    return jnp.where(
+        i < nx[:, None], xlink, jnp.where(j < ny[:, None], ylink, -1)
+    )
+
+
 def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
               llc_tag, llc_owner, sharers, line):
     """Gather the accessed L1 set and derive each way's EFFECTIVE MESI state.
@@ -358,25 +382,52 @@ def step(
     bid = jnp.where(et == EV_BARRIER, eaddr, 0)
     htile = bid % n_tiles
 
-    # ---- router-occupancy contention (NocConfig.contention) --------------
-    # Count this step's uncore transactions per home tile (memory winners +
-    # joins at the home bank; lock/unlock RMWs at the lock's home == the
-    # same btile; barrier arrivals at bid % n_tiles), then charge each
-    # transaction contention_lat * (count - 1) — mirroring golden's
-    # _tile_txns/_contention_extra exactly.
+    # ---- NoC contention (NocConfig.contention) ---------------------------
+    # This step's uncore transactions: memory winners + joins (home bank),
+    # lock/unlock RMWs (the lock's home == the same btile), barrier
+    # arrivals (bid % n_tiles). Tile model: occupancy count per home tile,
+    # charge contention_lat * (count - 1). Link model: each transaction's
+    # XY request+reply path (barrier arrivals: one way) claims its links;
+    # charge contention_lat * bottleneck (count - 1) over the path —
+    # mirroring golden's _bump/_contention_extra exactly.
     if cfg.noc.contention:
         ccl = cfg.noc.contention_lat
-        tcnt = jnp.zeros(n_tiles, jnp.int32)
         home_txn = winner | join
         if has_sync:
             home_txn = home_txn | is_lock | is_unlock
-        tcnt = tcnt.at[jnp.where(home_txn, btile, n_tiles)].add(1, mode="drop")
-        if has_sync:
-            tcnt = tcnt.at[jnp.where(is_barrier, htile, n_tiles)].add(
+        if cfg.noc.contention_model == "link":
+            from ..noc.mesh import n_links
+
+            NL = n_links(cfg)
+            req_p = _path_links(cfg, ctile, btile)  # [C, H]
+            rep_p = _path_links(cfg, btile, ctile)
+            arr_p = _path_links(cfg, ctile, htile)
+            lcnt = jnp.zeros(NL, jnp.int32)
+            for pth, mask in (
+                (req_p, home_txn),
+                (rep_p, home_txn),
+            ) + (((arr_p, is_barrier),) if has_sync else ()):
+                lcnt = lcnt.at[
+                    jnp.where(mask[:, None] & (pth >= 0), pth, NL)
+                ].add(1, mode="drop")
+
+            def _path_worst(pth):
+                cts = lcnt[jnp.where(pth >= 0, pth, 0)]
+                return jnp.max(jnp.where(pth >= 0, cts - 1, 0), axis=1)
+
+            extra_home = ccl * jnp.maximum(_path_worst(req_p), _path_worst(rep_p))
+            extra_bar = ccl * _path_worst(arr_p)
+        else:
+            tcnt = jnp.zeros(n_tiles, jnp.int32)
+            tcnt = tcnt.at[jnp.where(home_txn, btile, n_tiles)].add(
                 1, mode="drop"
             )
-        extra_home = ccl * (tcnt[btile] - 1)  # valid where home_txn
-        extra_bar = ccl * (tcnt[htile] - 1)  # valid where is_barrier
+            if has_sync:
+                tcnt = tcnt.at[jnp.where(is_barrier, htile, n_tiles)].add(
+                    1, mode="drop"
+                )
+            extra_home = ccl * (tcnt[btile] - 1)  # valid where home_txn
+            extra_bar = ccl * (tcnt[htile] - 1)  # valid where is_barrier
         cnt = cadd(
             cnt,
             "noc_contention_cycles",

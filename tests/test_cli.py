@@ -103,6 +103,22 @@ def test_cli_rejects_bad_input(tmp_path):
         main(["run", cfg_path, "--synth", "fft_like:bogus"])  # bad k=v
 
 
+def test_cli_xprof_writes_trace(tmp_path, capsys):
+    cfg_path = str(tmp_path / "m.json")
+    with open(cfg_path, "w") as f:
+        f.write(MachineConfig(n_cores=4, n_banks=4).to_json())
+    prof = str(tmp_path / "prof")
+    rc = main(
+        ["run", cfg_path, "--synth", "stream:n_mem_ops=10",
+         "--chunk-steps", "16", "--xprof", prof]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    found = [p for p in glob.glob(prof + "/**/*", recursive=True)
+             if os.path.isfile(p)]
+    assert found, "profiler trace directory is empty"
+
+
 def test_cli_info(capsys):
     cfg = os.path.join(REPO, "configs", "rung3_1024core_o3.json")
     assert main(["info", cfg]) == 0

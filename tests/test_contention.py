@@ -8,7 +8,12 @@ rung-3 "NoC-congestion heavy" config exists to show.
 import numpy as np
 import pytest
 
-from primesim_tpu.config.machine import NocConfig, small_test_config
+from primesim_tpu.config.machine import (
+    CacheConfig,
+    MachineConfig,
+    NocConfig,
+    small_test_config,
+)
 from primesim_tpu.golden.sim import GoldenSim
 from primesim_tpu.trace import synth
 from primesim_tpu.trace.format import EV_LD, EV_LOCK, EV_UNLOCK, from_event_lists
@@ -92,6 +97,109 @@ def test_parity_contention_8core_hot_bank():
         [(EV_LD, 4, (4 * i) * 64) for i in range(6)] for _ in range(8)
     ]  # lines 0,4,8,...: all bank 0
     assert_parity(cfg, from_event_lists(evs))
+
+
+# -------------------------------------------------- per-link ("link") model
+
+
+def test_engine_path_links_match_scalar_walk():
+    # the vectorized XY path builder must be link-for-link identical to
+    # the scalar noc.mesh.xy_links reference on every tile pair
+    import numpy as np
+
+    from primesim_tpu.noc.mesh import xy_links
+    from primesim_tpu.sim.engine import _path_links
+    import jax.numpy as jnp
+
+    cfg = small_test_config(4, noc=NocConfig(mesh_x=4, mesh_y=3))
+    nt = cfg.n_tiles
+    a = np.repeat(np.arange(nt), nt).astype(np.int32)
+    b = np.tile(np.arange(nt), nt).astype(np.int32)
+    got = np.asarray(_path_links(cfg, jnp.asarray(a), jnp.asarray(b)))
+    for k in range(nt * nt):
+        want = xy_links(int(a[k]), int(b[k]), 4)
+        row = tuple(x for x in got[k].tolist() if x >= 0)
+        assert row == want, (int(a[k]), int(b[k]), row, want)
+
+
+def test_golden_link_model_shared_link_queues():
+    # 1x4 mesh (tiles 0-1-2-3 in a row). Core 0 (tile 0) -> bank 2
+    # (tile 2) and core 1 (tile 1) -> bank 3 (tile 3): requests share the
+    # eastward link out of tile 1 (and tile 2's), so BOTH transactions
+    # queue (+1 each) even though their home TILES differ — exactly what
+    # the tile model cannot see.
+    cfg = small_test_config(
+        4, n_banks=4,
+        noc=NocConfig(mesh_x=4, mesh_y=1, contention=True,
+                      contention_model="link", contention_lat=1),
+    )
+    tr = from_event_lists(
+        [[(EV_LD, 4, 2 * 64)], [(EV_LD, 4, 3 * 64)], [], []]
+    )
+    g = GoldenSim(cfg, tr)
+    g.run()
+    np.testing.assert_array_equal(
+        g.counters["noc_contention_cycles"][:2], [1, 1]
+    )
+    # same machine under the tile model: different home tiles, no charge
+    cfg_t = small_test_config(
+        4, n_banks=4,
+        noc=NocConfig(mesh_x=4, mesh_y=1, contention=True,
+                      contention_model="tile", contention_lat=1),
+    )
+    gt = GoldenSim(cfg_t, tr)
+    gt.run()
+    assert gt.counters["noc_contention_cycles"].sum() == 0
+
+
+def test_golden_link_model_disjoint_paths_free():
+    # 2x2 mesh: core 0 (tile 0) -> bank 1 (tile 1) east link; core 2
+    # (tile 2) -> bank 3 (tile 3) east link at the other row — disjoint
+    cfg = small_test_config(
+        4, n_banks=4,
+        noc=NocConfig(mesh_x=2, mesh_y=2, contention=True,
+                      contention_model="link", contention_lat=1),
+    )
+    tr = from_event_lists(
+        [[(EV_LD, 4, 1 * 64)], [], [(EV_LD, 4, 3 * 64)], []]
+    )
+    g = GoldenSim(cfg, tr)
+    g.run()
+    assert g.counters["noc_contention_cycles"].sum() == 0
+
+
+@pytest.mark.parametrize(
+    "gen", ["false_sharing", "lock_contention", "barrier_phases"]
+)
+def test_parity_link_model(gen):
+    cfg = small_test_config(
+        8, n_banks=4, quantum=300,
+        noc=NocConfig(mesh_x=4, mesh_y=2, contention=True,
+                      contention_model="link", contention_lat=2),
+    )
+    tr = {
+        "false_sharing": lambda: synth.false_sharing(8, n_mem_ops=40, seed=71),
+        "lock_contention": lambda: synth.lock_contention(8, n_critical=8, seed=72),
+        "barrier_phases": lambda: synth.barrier_phases(8, n_phases=2, seed=73),
+    }[gen]()
+    assert_parity(cfg, tr, chunk_steps=50)
+
+
+def test_parity_link_model_16core_hot_path():
+    # many cores streaming through the same mesh column: heavy shared-link
+    # occupancy, engine and golden must agree bit-exactly
+    cfg = MachineConfig(
+        n_cores=16, n_banks=16,
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=8192, ways=4, line=64, latency=10),
+        noc=NocConfig(mesh_x=4, mesh_y=4, contention=True,
+                      contention_model="link", contention_lat=1),
+        quantum=400,
+    )
+    evs = [
+        [(EV_LD, 4, ((c + i) % 16) * 64) for i in range(8)] for c in range(16)
+    ]
+    assert_parity(cfg, from_event_lists(evs), chunk_steps=50)
 
 
 def test_contention_is_load_dependent():
