@@ -55,7 +55,7 @@ def _load_trace(ns, n_cores: int):
     from ..trace.format import Trace, fold_ins
 
     if ns.trace:
-        tr = Trace.load(ns.trace)
+        tr = Trace.load(ns.trace, mmap=getattr(ns, "mmap", False))
         return fold_ins(tr) if ns.fold else tr
     if ns.synth:
         return _parse_synth(ns.synth, n_cores, ns.fold)
@@ -84,18 +84,33 @@ def cmd_run(ns) -> int:
         )
 
     if ns.engine == "golden":
-        if ns.xprof or ns.debug_invariants:
+        if ns.xprof or ns.debug_invariants or ns.stream_window:
             raise SystemExit(
-                "--xprof/--debug-invariants require --engine jax "
-                "(the golden oracle has no device trace or chunk boundaries)"
+                "--xprof/--debug-invariants/--stream-window require "
+                "--engine jax (the golden oracle has no device loop)"
             )
         from ..golden.sim import GoldenSim
 
         t0 = time.perf_counter()
         sim = GoldenSim(cfg, tr)
-        sim.run(max_steps=ns.max_steps)
+        sim.run(max_steps=ns.max_steps or 10_000_000)
         wall = time.perf_counter() - t0
         cycles, counters = sim.cycles, sim.counters
+    elif ns.stream_window:
+        # bounded-memory windowed ingest: device memory O(C * window),
+        # host O(1) with --mmap; bit-exact vs the preloaded engine
+        from ..ingest.stream import StreamEngine
+
+        if ns.xprof or ns.debug_invariants:
+            raise SystemExit(
+                "--xprof/--debug-invariants are not supported with "
+                "--stream-window yet"
+            )
+        eng = StreamEngine(cfg, tr, window_events=ns.stream_window)
+        t0 = time.perf_counter()
+        eng.run(max_steps=ns.max_steps)  # None -> event-count-derived
+        wall = time.perf_counter() - t0
+        cycles, counters = eng.cycles, eng.counters
     else:
         import numpy as np
 
@@ -126,9 +141,12 @@ def cmd_run(ns) -> int:
 
         def _go():
             if ns.debug_invariants:
-                eng.run_chunked(max_steps=ns.max_steps, debug_invariants=True)
+                eng.run_chunked(
+                    max_steps=ns.max_steps or 10_000_000,
+                    debug_invariants=True,
+                )
             else:
-                eng.run(max_steps=ns.max_steps)
+                eng.run(max_steps=ns.max_steps or 10_000_000)
 
         t0 = time.perf_counter()
         if ns.xprof:
@@ -198,7 +216,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     r.add_argument("--engine", choices=("jax", "golden"), default="jax")
     r.add_argument("--chunk-steps", type=int, default=256)
-    r.add_argument("--max-steps", type=int, default=10_000_000)
+    r.add_argument(
+        "--max-steps", type=int, default=None,
+        help="step budget (default: 10M, or event-count-derived when "
+             "streaming)",
+    )
     r.add_argument("--report", help="write text report to this path")
     r.add_argument("--per-core-limit", type=int, default=64)
     r.add_argument(
@@ -210,6 +232,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--xprof",
         help="write a JAX profiler trace of the run to this directory "
              "(jax engine; inspect with xprof/tensorboard)",
+    )
+    r.add_argument(
+        "--stream-window", type=int, default=0, metavar="N",
+        help="stream the trace through N-event windows (bounded device "
+             "memory; bit-exact vs preloaded; for traces larger than HBM)",
+    )
+    r.add_argument(
+        "--mmap", action="store_true",
+        help="memory-map the trace file (pair with --stream-window for "
+             "traces larger than host memory)",
     )
     r.set_defaults(fn=cmd_run)
 

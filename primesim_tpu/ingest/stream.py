@@ -1,0 +1,172 @@
+"""Windowed (streaming) trace ingest — SURVEY.md §2 #8 / §7.
+
+The reference's UncoreManager drains a bounded queue of frontend events;
+the TPU-native equivalent streams a trace through BOUNDED device memory:
+the host holds per-core cursors into the (possibly memory-mapped) event
+source, uploads one `window_events`-deep window at a time, and the device
+`stream_loop` simulates until some core's window runs dry — its per-STEP
+exit condition fires before that core could have joined an arbitration it
+would have entered with the full trace, so windowed results are BIT-EXACT
+with a preloaded `Engine.run()`, LRU stamps included.
+
+This is what makes BASELINE rung-4/5 traces (billions of events, far
+beyond the [C, T, 4] device array a preloaded run needs) simulatable:
+device memory is O(C * window_events), host memory is O(1) beyond the
+mmapped file.
+
+    from primesim_tpu.ingest.stream import StreamEngine
+    eng = StreamEngine(cfg, Trace.load("huge.ptpu", mmap=True),
+                       window_events=4096)
+    eng.run()
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.machine import MachineConfig
+from ..stats.counters import COUNTER_NAMES, zero_counters
+from ..sim.engine import _ACC_BITS, stream_loop
+from ..sim.state import init_state
+from ..trace.format import EV_END, Trace, scan_trace_meta
+
+
+class StreamEngine:
+    """Bounded-memory streaming runner; results bit-exact vs Engine.run."""
+
+    def __init__(
+        self,
+        cfg: MachineConfig,
+        trace: Trace,
+        window_events: int = 1024,
+    ):
+        assert trace.n_cores == cfg.n_cores
+        if window_events < max(1, cfg.local_run_len + 1):
+            raise ValueError(
+                "window_events must cover at least one local run + 1 event"
+            )
+        self.cfg = cfg
+        self.trace = trace
+        # raw (possibly mmapped) source; byte-addressed traces are
+        # line-normalized PER WINDOW below so no full-array copy ever
+        # materializes (v4 line-addressed traces need no conversion, but
+        # their recorded line size must match — reuse the shared check)
+        if trace.line_addressed:
+            trace.line_events(cfg.line_bits)  # line-size validation only
+        self.src = trace.events
+        # one bounded-memory pass (chunked by core rows, mmap-friendly)
+        # for sync presence, the max instruction batch, and barrier ids
+        self.has_sync, per_ev, bad_bid = scan_trace_meta(
+            trace, cfg.barrier_slots
+        )
+        if bad_bid:
+            raise ValueError(
+                f"trace uses barrier ids >= barrier_slots={cfg.barrier_slots}"
+            )
+        # real (pre-END) event count per core
+        self.real_len = np.asarray(trace.lengths, dtype=np.int64) - 1
+        self.cursor = np.zeros(cfg.n_cores, dtype=np.int64)
+        self.W = int(window_events)
+        # 64-step on-device drain cadence bounds per-drain counter growth
+        if 64 * (cfg.local_run_len + 1) * per_ev >= 1 << _ACC_BITS:
+            raise ValueError(
+                "trace's max per-event instruction batch overflows the "
+                "streaming 64-step counter drain; split INS batches"
+            )
+        self.state = init_state(cfg)
+        self.cycle_base = np.int64(0)
+        self.host_counters = zero_counters(cfg.n_cores)
+        self.steps_run = 0
+
+    def _fill_window(self):
+        from ..trace.format import EV_LD, EV_LOCK, EV_ST, EV_UNLOCK
+
+        C = self.cfg.n_cores
+        buf = np.zeros((C, self.W + 1, 4), dtype=np.int32)
+        buf[:, :, 0] = EV_END
+        exhausted = np.zeros(C, dtype=bool)
+        filled = np.zeros(C, dtype=np.int32)
+        for c in range(C):
+            take = int(min(self.W, self.real_len[c] - self.cursor[c]))
+            if take > 0:
+                buf[c, :take] = self.src[c, self.cursor[c] : self.cursor[c] + take]
+            filled[c] = max(take, 0)
+            exhausted[c] = self.cursor[c] + take >= self.real_len[c]
+        if not self.trace.line_addressed:
+            t = buf[:, :, 0]
+            addr_ev = (
+                (t == EV_LD) | (t == EV_ST) | (t == EV_LOCK) | (t == EV_UNLOCK)
+            )
+            buf[:, :, 2] = np.where(
+                addr_ev, buf[:, :, 2] >> self.cfg.line_bits, buf[:, :, 2]
+            )
+        return buf, exhausted, filled
+
+    def run(self, max_steps: int | None = None) -> None:
+        """Stream to completion. `max_steps` defaults to a budget derived
+        from the trace's total event count (retries/spins included via a
+        generous per-event multiplier) — a 10M constant would abort the
+        billion-event runs this engine exists for."""
+        cfg = self.cfg
+        C = cfg.n_cores
+        if max_steps is None:
+            max_steps = max(10_000_000, 64 * int(self.real_len.sum()))
+        budget = max_steps
+        while True:
+            buf, exhausted, filled = self._fill_window()
+            st = self.state._replace(ptr=jnp.zeros(C, jnp.int32))
+            st, acc_lo, acc_hi, base_lo, base_hi, k = stream_loop(
+                cfg,
+                jnp.asarray(buf),
+                st,
+                jnp.asarray(exhausted),
+                jnp.asarray(filled),
+                jnp.asarray(min(budget, 2**31 - 1), jnp.int32),
+                has_sync=self.has_sync,
+            )
+            # drain: periodic on-device accumulators + the <=63-step residue
+            acc = (
+                (np.asarray(acc_hi).astype(np.int64) << _ACC_BITS)
+                + np.asarray(acc_lo).astype(np.int64)
+                + np.asarray(st.counters).astype(np.int64)
+            )
+            for i, name in enumerate(COUNTER_NAMES):
+                self.host_counters[name] += acc[i]
+            self.cycle_base += (
+                np.int64(np.asarray(base_hi)) << _ACC_BITS
+            ) + np.int64(np.asarray(base_lo))
+            st = st._replace(counters=jnp.zeros_like(st.counters))
+            consumed = np.asarray(st.ptr).astype(np.int64)
+            k_int = int(np.asarray(k))
+            self.steps_run += k_int
+            budget -= k_int
+            self.state = st
+            at_end = (
+                buf[np.arange(C), np.minimum(consumed, self.W), 0] == EV_END
+            )
+            self.cursor += consumed
+            if (at_end & exhausted).all():
+                return
+            if budget <= 0:
+                raise RuntimeError(
+                    f"stream engine: step budget ({max_steps}) exhausted at "
+                    f"{int(self.cursor.sum())}/{int(self.real_len.sum())} "
+                    "events consumed — deadlocked barrier/lock, or pass a "
+                    "larger max_steps"
+                )
+            if k_int == 0 and not consumed.any():
+                raise RuntimeError(
+                    "stream engine: no progress in a window (window_events "
+                    "too small for this trace shape?)"
+                )
+
+    # ---- results (Engine-compatible surface) -----------------------------
+
+    @property
+    def cycles(self) -> np.ndarray:
+        return np.asarray(self.state.cycles).astype(np.int64) + self.cycle_base
+
+    @property
+    def counters(self):
+        return self.host_counters

@@ -893,6 +893,32 @@ def _device_done(events, st, arange_c):
     return jnp.all(events[arange_c, p, 0] == EV_END)
 
 
+def _drain_and_rebase(cfg, st, acc_lo, acc_hi, base_lo, base_hi, nd):
+    """On-device housekeeping shared by run_loop and stream_loop: drain
+    int32 step counters into (lo, hi) carry pairs (hi above 2^30), and
+    rebase the epoch-relative clocks by a whole number of quanta — the
+    minimum over `nd` (not-done) lanes — including occupied barrier
+    slots' arrival clocks."""
+    Q = cfg.quantum
+    acc_lo = acc_lo + st.counters
+    acc_hi = acc_hi + (acc_lo >> _ACC_BITS)
+    acc_lo = acc_lo & ((1 << _ACC_BITS) - 1)
+    st = st._replace(counters=jnp.zeros_like(st.counters))
+    m = jnp.min(jnp.where(nd, st.cycles, INT32_MAX))
+    delta = jnp.where(jnp.any(nd), (m // Q) * Q, 0)
+    st = st._replace(
+        cycles=st.cycles - delta,
+        quantum_end=st.quantum_end - delta,
+        barrier_time=jnp.where(
+            st.barrier_count > 0, st.barrier_time - delta, st.barrier_time
+        ),
+    )
+    base_lo = base_lo + delta
+    base_hi = base_hi + (base_lo >> _ACC_BITS)
+    base_lo = base_lo & ((1 << _ACC_BITS) - 1)
+    return st, acc_lo, acc_hi, base_lo, base_hi
+
+
 @functools.partial(
     jax.jit, static_argnums=(0, 1), static_argnames=("has_sync",)
 )
@@ -909,7 +935,6 @@ def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
     (SURVEY.md §3.4) with zero host round-trips until the run completes.
     """
     C = cfg.n_cores
-    Q = cfg.quantum
     T = events.shape[1]
     arange_c = jnp.arange(C, dtype=jnp.int32)
 
@@ -924,30 +949,68 @@ def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
             return step(cfg, events, c, has_sync=has_sync), None
 
         st, _ = jax.lax.scan(sbody, st, None, length=chunk_steps)
-        # drain counters (lo/hi pair; both stay < 2^31)
-        acc_lo = acc_lo + st.counters
-        acc_hi = acc_hi + (acc_lo >> _ACC_BITS)
-        acc_lo = acc_lo & ((1 << _ACC_BITS) - 1)
-        st = st._replace(counters=jnp.zeros_like(st.counters))
-        # rebase clocks by a whole number of quanta. barrier_time entries of
-        # OCCUPIED slots are epoch-relative max-arrival clocks, so they
-        # rebase with the core clocks (delta <= every frozen waiter's
-        # arrival clock <= the slot max, so they stay non-negative);
-        # unoccupied slots hold the reset value 0 and must stay 0.
         p = jnp.minimum(st.ptr, T - 1)
         nd = events[arange_c, p, 0] != EV_END
-        m = jnp.min(jnp.where(nd, st.cycles, INT32_MAX))
-        delta = jnp.where(jnp.any(nd), (m // Q) * Q, 0)
-        st = st._replace(
-            cycles=st.cycles - delta,
-            quantum_end=st.quantum_end - delta,
-            barrier_time=jnp.where(
-                st.barrier_count > 0, st.barrier_time - delta, st.barrier_time
-            ),
+        st, acc_lo, acc_hi, base_lo, base_hi = _drain_and_rebase(
+            cfg, st, acc_lo, acc_hi, base_lo, base_hi, nd
         )
-        base_lo = base_lo + delta
-        base_hi = base_hi + (base_lo >> _ACC_BITS)
-        base_lo = base_lo & ((1 << _ACC_BITS) - 1)
+        return st, acc_lo, acc_hi, base_lo, base_hi, k + 1
+
+    acc_lo = jnp.zeros_like(st.counters)
+    acc_hi = jnp.zeros_like(st.counters)
+    base_lo = jnp.asarray(0, jnp.int32)
+    base_hi = jnp.asarray(0, jnp.int32)
+    k = jnp.asarray(0, jnp.int32)
+    return jax.lax.while_loop(
+        cond, body, (st, acc_lo, acc_hi, base_lo, base_hi, k)
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0,), static_argnames=("has_sync",)
+)
+def stream_loop(cfg: MachineConfig, events, st: MachineState, exhausted,
+                filled, max_steps, has_sync: bool = True):
+    """Device loop for WINDOWED (streaming) ingest — SURVEY.md §2 #8's
+    bounded-buffer hand-off: the events array holds only a window of each
+    core's stream, END-padded; `exhausted[c]` marks cores with no events
+    beyond their window and `filled[c]` counts the real events buffered.
+
+    The while_loop cond runs EVERY step and exits while every live core
+    still has at least local_run_len + 1 buffered events — the most one
+    step can consume — so no step ever observes a window's fake END
+    mid-run (which would truncate a local run or drop the core from an
+    arbitration it would have joined with the full trace). Windowed
+    simulation is therefore BIT-EXACT with the preloaded run, including
+    LRU stamps (step_no advances only on executed steps). Counters drain
+    and clocks rebase on-device every 64 steps, same arithmetic as
+    run_loop.
+    """
+    C = cfg.n_cores
+    T = events.shape[1]
+    need = cfg.local_run_len + 1
+    arange_c = jnp.arange(C, dtype=jnp.int32)
+
+    def at_end(s):
+        p = jnp.minimum(s.ptr, T - 1)
+        return events[arange_c, p, 0] == EV_END
+
+    def cond(carry):
+        st, acc_lo, acc_hi, base_lo, base_hi, k = carry
+        # a live lane running low on buffered events hands back to the
+        # host BEFORE a step could touch the window boundary
+        low = jnp.any(~exhausted & (filled - st.ptr < need))
+        return (k < max_steps) & ~low & ~jnp.all(at_end(st))
+
+    def body(carry):
+        st, acc_lo, acc_hi, base_lo, base_hi, k = carry
+        st = step(cfg, events, st, has_sync=has_sync)
+        st, acc_lo, acc_hi, base_lo, base_hi = jax.lax.cond(
+            (k & 63) == 63,
+            lambda args: _drain_and_rebase(cfg, *args, ~at_end(args[0])),
+            lambda args: args,
+            (st, acc_lo, acc_hi, base_lo, base_hi),
+        )
         return st, acc_lo, acc_hi, base_lo, base_hi, k + 1
 
     acc_lo = jnp.zeros_like(st.counters)

@@ -78,8 +78,13 @@ class Trace:
         lengths: np.ndarray,
         line_addressed: bool = False,
         line_bits: int | None = None,
+        validate: bool = True,
     ):
-        events = np.asarray(events, dtype=np.int32)
+        """`validate=False` skips the eager whole-array scans (used by the
+        mmap load path, where touching every page defeats lazy loading;
+        the engines' ingest checks still apply per window)."""
+        if validate:
+            events = np.asarray(events, dtype=np.int32)
         lengths = np.asarray(lengths, dtype=np.int32)
         assert events.ndim == 3 and events.shape[2] == N_FIELDS
         assert lengths.shape == (events.shape[0],)
@@ -87,7 +92,7 @@ class Trace:
         # line size (log2) the line indices were derived with; None =
         # unknown/not applicable (byte-addressed traces)
         self.line_bits = line_bits if line_addressed else None
-        t = events[:, :, 0]
+        t = events[:, :, 0] if validate else np.zeros(0)
         if t.size:
             if not ((t >= EV_INS) & (t <= EV_BARRIER)).all():
                 raise ValueError("trace contains invalid event types")
@@ -162,7 +167,13 @@ class Trace:
             self.events.astype("<i4").tofile(f)
 
     @staticmethod
-    def load(path: str) -> "Trace":
+    def load(path: str, mmap: bool = False) -> "Trace":
+        """Load a PTPU trace; `mmap=True` memory-maps the event array so
+        host memory stays O(1) — pair with ingest.stream.StreamEngine for
+        traces larger than host/device memory. mmap skips the eager
+        whole-array validation pass (windows still hit engine checks) and
+        requires a 4-field (v2+) file.
+        """
         with open(path, "rb") as f:
             hdr = np.fromfile(f, dtype="<u4", count=4)
             if hdr.shape[0] != 4 or hdr[0] != MAGIC:
@@ -178,6 +189,25 @@ class Trace:
                 flags = int(fw[0])
             n_cores, max_len = int(hdr[2]), int(hdr[3])
             lengths = np.fromfile(f, dtype="<u4", count=n_cores).astype(np.int32)
+            lb = (flags >> 8) & 0xFF
+            line_addressed = bool(flags & FLAG_LINE_ADDRESSED)
+            if mmap:
+                if nf != N_FIELDS:
+                    raise ValueError(
+                        f"{path}: mmap loading requires a 4-field (v2+) "
+                        "trace; this is v1"
+                    )
+                events = np.memmap(
+                    path, dtype="<i4", mode="r", offset=f.tell(),
+                    shape=(n_cores, max_len, nf),
+                )
+                return Trace(
+                    events,
+                    lengths,
+                    line_addressed=line_addressed,
+                    line_bits=lb if lb else None,
+                    validate=False,
+                )
             events = np.fromfile(f, dtype="<i4", count=n_cores * max_len * nf)
             if events.size != n_cores * max_len * nf:
                 raise ValueError(f"{path}: truncated trace file")
@@ -186,11 +216,10 @@ class Trace:
                 events = np.concatenate(
                     [events, np.zeros((n_cores, max_len, 1), np.int32)], axis=2
                 )
-        lb = (flags >> 8) & 0xFF
         return Trace(
             events,
             lengths,
-            line_addressed=bool(flags & FLAG_LINE_ADDRESSED),
+            line_addressed=line_addressed,
             line_bits=lb if lb else None,
         )
 
@@ -201,11 +230,41 @@ def validate_sync(trace: Trace, barrier_slots: int) -> None:
     Shared by both engines (golden + JAX) so they accept exactly the same
     traces; barrier ids are dense ints < barrier_slots by contract.
     """
-    t = trace.events[:, :, 0]
-    if (trace.events[:, :, 2][t == EV_BARRIER] >= barrier_slots).any():
+    _, _, bad_bid = scan_trace_meta(trace, barrier_slots)
+    if bad_bid:
         raise ValueError(
             f"trace uses barrier ids >= barrier_slots={barrier_slots}"
         )
+
+
+def scan_trace_meta(
+    trace: Trace, barrier_slots: int, rows_per_chunk: int = 256
+) -> tuple[bool, int, bool]:
+    """One bounded-memory pass over a (possibly memory-mapped) trace:
+    returns (has_sync, max per-event instruction batch, any barrier id >=
+    barrier_slots). Chunked by core rows so peak host memory is
+    O(rows_per_chunk * max_len), never O(file) — the streaming engine's
+    whole point is traces bigger than RAM."""
+    has_sync = False
+    per_ev = 1
+    bad_bid = False
+    for lo in range(0, trace.n_cores, rows_per_chunk):
+        ev = np.asarray(trace.events[lo : lo + rows_per_chunk])
+        t = ev[:, :, 0]
+        if not has_sync:
+            has_sync = bool(
+                ((t == EV_LOCK) | (t == EV_UNLOCK) | (t == EV_BARRIER)).any()
+            )
+        per_ev = max(
+            per_ev,
+            int(ev[:, :, 1].max(initial=0)),
+            int(ev[:, :, 3].max(initial=0)) + 1,
+        )
+        if not bad_bid:
+            bad_bid = bool(
+                (ev[:, :, 2][t == EV_BARRIER] >= barrier_slots).any()
+            )
+    return has_sync, per_ev, bad_bid
 
 
 def from_event_lists(

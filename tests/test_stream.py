@@ -1,0 +1,138 @@
+"""Windowed streaming ingest (SURVEY.md §2 #8 / §7 bounded-buffer
+hand-off): StreamEngine must be BIT-EXACT with the preloaded Engine —
+cycles, pointers-consumed, every counter, and the full machine state
+including LRU stamps — for any window size, because the device loop's
+per-step exit fires before a starved core could diverge an arbitration.
+"""
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import MachineConfig, small_test_config
+from primesim_tpu.ingest.stream import StreamEngine
+from primesim_tpu.sim.engine import Engine
+from primesim_tpu.trace import synth
+from primesim_tpu.trace.format import Trace, fold_ins
+
+
+def assert_stream_matches_preloaded(cfg, trace, window_events):
+    full = Engine(cfg, trace, chunk_steps=32)
+    full.run()
+    s = StreamEngine(cfg, trace, window_events=window_events)
+    s.run()
+    np.testing.assert_array_equal(s.cycles, full.cycles, err_msg="cycles")
+    fc = full.counters
+    for k, v in s.counters.items():
+        np.testing.assert_array_equal(v, fc[k], err_msg=f"counter {k}")
+    # full machine state, LRU stamps included (exactness claim): compare
+    # every field except (a) the window-relative trace pointers, (b) the
+    # EPOCH-relative clocks (rebase schedules differ between the fused and
+    # streaming loops; absolute cycles are compared above via the property,
+    # and quantum_end/barrier_time shift with the same epoch), and (c) the
+    # step counter: the fused loop rounds up to whole chunks, executing
+    # trailing EMPTY steps after completion (no retires, no state writes),
+    # while the streaming loop exits exactly at completion
+    for f in s.state._fields:
+        if f in ("ptr", "cycles", "quantum_end", "barrier_time", "step"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s.state, f)),
+            np.asarray(getattr(full.state, f)),
+            err_msg=f,
+        )
+    # total events consumed must equal the real per-core stream lengths
+    np.testing.assert_array_equal(
+        s.cursor, np.asarray(trace.lengths, dtype=np.int64) - 1
+    )
+
+
+@pytest.mark.parametrize("window", [4, 16, 64])
+def test_stream_bit_exact_memory_workload(window):
+    cfg = small_test_config(8, n_banks=4, quantum=300)
+    assert_stream_matches_preloaded(
+        cfg, synth.false_sharing(8, n_mem_ops=40, seed=81), window
+    )
+
+
+def test_stream_bit_exact_folded_local_runs():
+    cfg = small_test_config(8, n_banks=4, local_run_len=4)
+    tr = fold_ins(synth.fft_like(8, n_phases=2, points_per_core=12, seed=82))
+    assert_stream_matches_preloaded(cfg, tr, window_events=8)
+
+
+@pytest.mark.parametrize("gen_seed", [("lock", 83), ("barrier", 84)])
+def test_stream_bit_exact_sync(gen_seed):
+    # frozen barrier waiters and spinning lock lanes must survive window
+    # boundaries (their un-retired event re-enters the next window)
+    gen, seed = gen_seed
+    cfg = small_test_config(8, n_banks=4, quantum=200)
+    tr = (
+        synth.lock_contention(8, n_critical=8, seed=seed)
+        if gen == "lock"
+        else synth.barrier_phases(8, n_phases=3, seed=seed)
+    )
+    assert_stream_matches_preloaded(cfg, tr, window_events=8)
+
+
+def test_stream_uneven_core_lengths():
+    # cores exhaust their streams at very different times; starved-exit
+    # must not stall finished cores or starve long ones
+    from primesim_tpu.trace.format import EV_INS, EV_LD, from_event_lists
+
+    cfg = small_test_config(4, n_banks=4)
+    tr = from_event_lists(
+        [
+            [(EV_LD, 4, i * 64) for i in range(50)],
+            [(EV_INS, 10, 0), (EV_LD, 4, 7 * 64)],
+            [],
+            [(EV_LD, 4, i * 64) for i in range(23)],
+        ]
+    )
+    assert_stream_matches_preloaded(cfg, tr, window_events=5)
+
+
+def test_stream_mmap_roundtrip(tmp_path):
+    # mmapped on-disk v4 trace through the streaming engine: host memory
+    # stays O(window), results identical to the in-memory run
+    cfg = small_test_config(8, n_banks=4)
+    tr = synth.uniform_random(8, n_mem_ops=60, seed=85)
+    line_tr = Trace(
+        tr.line_events(cfg.line_bits), tr.lengths,
+        line_addressed=True, line_bits=cfg.line_bits,
+    )
+    p = str(tmp_path / "big.ptpu")
+    line_tr.save(p)
+    mm = Trace.load(p, mmap=True)
+    assert isinstance(mm.events, np.memmap) and mm.line_addressed
+    assert_stream_matches_preloaded(cfg, mm, window_events=16)
+
+
+def test_stream_rejects_undersized_window():
+    cfg = small_test_config(4, local_run_len=8)
+    with pytest.raises(ValueError, match="window_events"):
+        StreamEngine(cfg, synth.stream(4, n_mem_ops=4), window_events=4)
+
+
+def test_cli_stream_window(tmp_path, capsys):
+    import json
+
+    from primesim_tpu.cli import main
+
+    cfg_path = str(tmp_path / "m.json")
+    with open(cfg_path, "w") as f:
+        f.write(MachineConfig(n_cores=8, n_banks=8).to_json())
+    tr_path = str(tmp_path / "t.ptpu")
+    synth.false_sharing(8, n_mem_ops=30, seed=86).save(tr_path)
+    rc = main(
+        ["run", cfg_path, "--trace", tr_path, "--mmap",
+         "--stream-window", "16"]
+    )
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # streamed result must equal the preloaded CLI run on the same trace
+    rc = main(["run", cfg_path, "--trace", tr_path])
+    assert rc == 0
+    d2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["detail"]["instructions"] == d2["detail"]["instructions"]
+    assert d["detail"]["max_core_cycles"] == d2["detail"]["max_core_cycles"]
+    assert d["detail"]["noc_msgs"] == d2["detail"]["noc_msgs"]
