@@ -114,6 +114,7 @@ def cmd_run(ns) -> int:
     else:
         import numpy as np
 
+        import jax
         import jax.numpy as jnp
 
         from ..sim.engine import Engine, run_chunk, run_loop
@@ -138,6 +139,11 @@ def cmd_run(ns) -> int:
             )
             np.asarray(out[0].cycles)
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
+        # block on the async event/state uploads before the clock starts
+        # (a lazy transfer through a remote-TPU tunnel otherwise lands
+        # inside the timed dispatch and is billed to simulation)
+        jax.block_until_ready(eng.events)
+        jax.block_until_ready(eng.state.cycles)
 
         def _go():
             if ns.debug_invariants:
