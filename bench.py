@@ -63,19 +63,15 @@ def main() -> None:
     )
     np.asarray(out[0].cycles)  # block
 
-    # best of three timed runs: the remote-TPU tunnel adds +-30% run-to-run
-    # jitter (r4 sweep: rl8/chunk512 measured 3.07 and 4.12 MIPS minutes
-    # apart); the fastest run is the truer device-rate measurement. Block
-    # on the async event/state uploads BEFORE starting the clock — through
-    # the tunnel a lazy 17 MB transfer otherwise lands inside the timed
-    # dispatch and is billed to simulation.
-    import jax
-
+    # best of three timed runs, each synced on its async uploads BEFORE
+    # the clock starts (a lazy multi-MB transfer through the remote-TPU
+    # tunnel otherwise lands inside the timed dispatch — that, not device
+    # compute, was the round-4 "+-30% jitter"); the fastest run is the
+    # truer device-rate measurement
     walls = []
     for _ in range(3):
         eng = Engine(cfg, trace, chunk_steps=CHUNK)
-        jax.block_until_ready(eng.events)
-        jax.block_until_ready(eng.state.cycles)
+        eng.block_until_ready()
         t0 = time.perf_counter()
         eng.run(max_steps=10_000_000)
         walls.append(time.perf_counter() - t0)

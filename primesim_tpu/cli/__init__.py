@@ -139,11 +139,7 @@ def cmd_run(ns) -> int:
             )
             np.asarray(out[0].cycles)
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
-        # block on the async event/state uploads before the clock starts
-        # (a lazy transfer through a remote-TPU tunnel otherwise lands
-        # inside the timed dispatch and is billed to simulation)
-        jax.block_until_ready(eng.events)
-        jax.block_until_ready(eng.state.cycles)
+        eng.block_until_ready()  # don't bill async uploads to simulation
 
         def _go():
             if ns.debug_invariants:
@@ -156,8 +152,6 @@ def cmd_run(ns) -> int:
 
         t0 = time.perf_counter()
         if ns.xprof:
-            import jax
-
             with jax.profiler.trace(ns.xprof):
                 _go()
             print(f"profiler trace written to {ns.xprof}", file=sys.stderr)

@@ -1182,6 +1182,15 @@ class Engine:
             if debug_invariants:
                 self.verify_invariants()
 
+    def block_until_ready(self) -> None:
+        """Synchronize the engine's async device uploads (events + the
+        whole state pytree). Call before starting a wall-clock measurement:
+        through a remote-TPU tunnel a lazy multi-MB transfer otherwise
+        completes inside the first timed dispatch and is billed to
+        simulation."""
+        jax.block_until_ready(self.events)
+        jax.block_until_ready(self.state)
+
     def verify_invariants(self) -> None:
         """Check the DESIGN.md §5 machine invariants on the current state
         (host-side; raises AssertionError naming the violation)."""
