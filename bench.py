@@ -42,7 +42,8 @@ def main() -> None:
         noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
         dram_lat=100,
         quantum=1000,
-        # swept on TPU (round 3): rl 4 -> 4.02, 8 -> 4.04, 12 -> 3.06 MIPS
+        # swept on TPU with upload-synced timing (r4): rl 4 -> 4.27,
+        # 6 -> 4.24, 8 -> 4.72, 10 -> 4.20, 12 -> 3.82 MIPS
         local_run_len=8,
     )
     from primesim_tpu.trace.format import fold_ins
@@ -96,13 +97,14 @@ def main() -> None:
                     "sim_cycles_per_s": round(agg_cycles / wall),
                     "noc_msgs": int(eng.counters["noc_msgs"].sum()),
                     # STATIC RECORD, not part of this run: the round-4
-                    # local_run_len x chunk_steps sweep measured on TPU
-                    # 2026-07-30 (single runs; tunnel jitter ~+-30%),
-                    # justifying the rl=8 default above
+                    # tuning sweeps measured on TPU 2026-07-30 with
+                    # upload-synced timing (best-of-2 each), justifying
+                    # the rl=8 / chunk=512 defaults above
                     "sweep_mips_static_r4_2026_07_30": {
-                        "rl4_chunk256": 3.432, "rl4_chunk512": 3.692,
-                        "rl8_chunk256": 4.095, "rl8_chunk512": 3.066,
-                        "rl12_chunk256": 2.999, "rl12_chunk512": 2.815,
+                        "rl4": 4.265, "rl6": 4.236, "rl8": 4.717,
+                        "rl10": 4.195, "rl12": 3.819,
+                        "chunk128": 4.775, "chunk256": 4.796,
+                        "chunk512": 4.808, "chunk1024": 3.704,
                     },
                 },
             }
