@@ -107,6 +107,10 @@ def cmd_run(ns) -> int:
                 "--stream-window yet"
             )
         eng = StreamEngine(cfg, tr, window_events=ns.stream_window)
+        # warm the jit cache at the run's window shapes so the reported
+        # MIPS measures simulation, not compilation — same protocol as the
+        # preloaded path above
+        eng.warmup()
         t0 = time.perf_counter()
         eng.run(max_steps=ns.max_steps)  # None -> event-count-derived
         wall = time.perf_counter() - t0

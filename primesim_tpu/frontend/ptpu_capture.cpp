@@ -171,13 +171,28 @@ void thread_register() {
   }
   t_core = c;
   ThreadRec& tr = g_threads[c];
-  tr.ev = (Event*)malloc(sizeof(Event) * 4096);
-  tr.cap = 4096;
-  tr.n = 0;
-  tr.perf_fd = perf_open_self();
-  tr.tsc_fallback = tr.perf_fd < 0;
-  tr.last_count = counter_read(tr);
-  tr.active = true;
+  // Registration writes happen under tr.mu: write_trace()'s locked flush
+  // pass can run concurrently when the process exits while a worker is
+  // mid-registration, and without the lock `active = true` could become
+  // visible before ev/cap under relaxed ordering (unsynchronized race).
+  // t_in_shim guards the whole section: malloc/read below may call the
+  // interposed memcpy/memset, whose emit would spin on the held tr.mu.
+  bool saved_in_shim = t_in_shim;
+  t_in_shim = true;
+  tr.lock();
+  if (!g_shutdown.load(std::memory_order_relaxed)) {
+    tr.ev = (Event*)malloc(sizeof(Event) * 4096);
+    tr.cap = 4096;
+    tr.n = 0;
+    tr.perf_fd = perf_open_self();
+    tr.tsc_fallback = tr.perf_fd < 0;
+    tr.last_count = counter_read(tr);
+    tr.active = true;
+  } else {
+    t_core = -2;  // trace already written: capture nothing for this thread
+  }
+  tr.unlock();
+  t_in_shim = saved_in_shim;
 }
 
 // instructions retired since the last event; TSC fallback scales cycles
@@ -389,11 +404,16 @@ void* thread_trampoline(void* p) {
   void* r = a.fn(a.arg);
   if (t_core >= 0) {
     // flush the thread's trailing instruction batch while it still runs
+    // (t_in_shim: flush may realloc, whose memcpy would re-enter emit and
+    // spin on the held tr.mu)
     ThreadRec& tr = g_threads[t_core];
+    bool saved_in_shim = t_in_shim;
+    t_in_shim = true;
     tr.lock();
     if (!g_shutdown.load(std::memory_order_relaxed)) flush_pending(tr);
     tr.active = false;
     tr.unlock();
+    t_in_shim = saved_in_shim;
   }
   return r;
 }

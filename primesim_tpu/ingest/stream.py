@@ -85,14 +85,21 @@ class StreamEngine:
         C = self.cfg.n_cores
         buf = np.zeros((C, self.W + 1, 4), dtype=np.int32)
         buf[:, :, 0] = EV_END
-        exhausted = np.zeros(C, dtype=bool)
-        filled = np.zeros(C, dtype=np.int32)
-        for c in range(C):
-            take = int(min(self.W, self.real_len[c] - self.cursor[c]))
-            if take > 0:
-                buf[c, :take] = self.src[c, self.cursor[c] : self.cursor[c] + take]
-            filled[c] = max(take, 0)
-            exhausted[c] = self.cursor[c] + take >= self.real_len[c]
+        # vectorized fill: one gather over per-core cursors instead of an
+        # O(C) Python loop (the loop was the wall at 4096-16384 cores —
+        # thousands of host iterations per window refill). Peak temporaries
+        # stay O(C * W), the same bound as the window itself.
+        take = np.minimum(self.W, self.real_len - self.cursor)
+        take = np.maximum(take, 0)
+        idx = self.cursor[:, None] + np.arange(self.W, dtype=np.int64)[None, :]
+        valid = idx < (self.cursor + take)[:, None]
+        idx = np.minimum(idx, self.src.shape[1] - 1)
+        vals = np.take_along_axis(
+            self.src, idx[:, :, None], axis=1
+        )  # [C, W, 4]; memmap sources fault in only the touched pages
+        buf[:, : self.W] = np.where(valid[:, :, None], vals, buf[:, : self.W])
+        filled = take.astype(np.int32)
+        exhausted = self.cursor + take >= self.real_len
         if not self.trace.line_addressed:
             t = buf[:, :, 0]
             addr_ev = (
@@ -102,6 +109,26 @@ class StreamEngine:
                 addr_ev, buf[:, :, 2] >> self.cfg.line_bits, buf[:, :, 2]
             )
         return buf, exhausted, filled
+
+    def warmup(self) -> None:
+        """Compile `stream_loop` at this run's window shapes with a
+        ZERO-step budget (the budget is a traced arg, so the real run
+        reuses the compilation) and block until ready. Call before a
+        wall-clock measurement, mirroring Engine.block_until_ready —
+        keeping this next to run() so the warm-up and the real dispatch
+        cannot desynchronize."""
+        cfg = self.cfg
+        buf, exhausted, filled = self._fill_window()
+        out = stream_loop(
+            cfg,
+            jnp.asarray(buf),
+            self.state._replace(ptr=jnp.zeros(cfg.n_cores, jnp.int32)),
+            jnp.asarray(exhausted),
+            jnp.asarray(filled),
+            jnp.asarray(0, jnp.int32),
+            has_sync=self.has_sync,
+        )
+        np.asarray(out[0].cycles)  # block until compiled
 
     def run(self, max_steps: int | None = None) -> None:
         """Stream to completion. `max_steps` defaults to a budget derived

@@ -235,6 +235,18 @@ def step(
     cycles_c, ptr_c = st.cycles, st.ptr
     l1_state_c, l1_lru_c = st.l1_state, st.l1_lru
     run = jnp.ones(C, bool)
+    # Per-iteration L1 scatters and counter bumps are DEFERRED out of the
+    # unrolled loop (accumulated below, applied once after it): nothing in
+    # the loop reads l1_lru, and the probe treats E and M identically (a
+    # match needs state != I; a write hit needs state >= E), so a deferred
+    # silent E->M is invisible to later iterations — 2*rl scatters + 3*rl
+    # counter updates collapse to 2 + 3. Duplicate (row, col) pairs across
+    # iterations write identical values (step_no / M), so the merged
+    # scatter is order-independent.
+    rhit_acc = jnp.zeros(C, jnp.int32)
+    whit_acc = jnp.zeros(C, jnp.int32)
+    ins_acc = jnp.zeros(C, jnp.int32)
+    hit_masks, whit_masks, hit_cols = [], [], []
     for _ in range(cfg.local_run_len):
         pr = jnp.minimum(ptr_c, T - 1)
         evr = events[arange_c, pr]  # [C, 4]
@@ -257,21 +269,28 @@ def step(
             jnp.where(hit_r, eprer * cpi_vec + cfg.l1.latency, 0),
         )
         ptr_c = ptr_c + local.astype(jnp.int32)
-        cnt = cadd(cnt, "l1_read_hits", r_hit)
-        cnt = cadd(cnt, "l1_write_hits", w_hit)
-        cnt = cadd(
-            cnt,
-            "instructions",
-            jnp.where(is_ins_r, eargr, 0) + jnp.where(hit_r, eprer + 1, 0),
+        rhit_acc = rhit_acc + r_hit
+        whit_acc = whit_acc + w_hit
+        ins_acc = ins_acc + (
+            jnp.where(is_ins_r, eargr, 0) + jnp.where(hit_r, eprer + 1, 0)
         )
-        # one-hot row updates as [C]-element scatters (drop masked lanes)
+        hit_masks.append(hit_r)
+        whit_masks.append(w_hit)
+        hit_cols.append(hit_col_r)
+        run = local  # stop at the first non-local event
+    if cfg.local_run_len:
+        cnt = cadd(cnt, "l1_read_hits", rhit_acc)
+        cnt = cadd(cnt, "l1_write_hits", whit_acc)
+        cnt = cadd(cnt, "instructions", ins_acc)
+        hm = jnp.stack(hit_masks, axis=1)  # [C, rl]
+        wm = jnp.stack(whit_masks, axis=1)
+        cm = jnp.stack(hit_cols, axis=1)
         l1_lru_c = l1_lru_c.at[
-            jnp.where(hit_r, arange_c, C), hit_col_r
+            jnp.where(hm, arange_c[:, None], C), cm
         ].set(step_no, mode="drop")
         l1_state_c = l1_state_c.at[
-            jnp.where(w_hit, arange_c, C), hit_col_r
+            jnp.where(wm, arange_c[:, None], C), cm
         ].set(M, mode="drop")
-        run = local  # stop at the first non-local event
 
     # ---- phase 0.9: gather the arbitration-phase events ------------------
     p = jnp.minimum(ptr_c, T - 1)
@@ -1005,9 +1024,16 @@ def stream_loop(cfg: MachineConfig, events, st: MachineState, exhausted,
     def body(carry):
         st, acc_lo, acc_hi, base_lo, base_hi, k = carry
         st = step(cfg, events, st, has_sync=has_sync)
+        # not-done for the rebase: a core at its window's fake END padding
+        # (ptr past `filled` but the stream continues, ~exhausted) is LIVE —
+        # it must still bound the rebase minimum, else the uniform shift
+        # could push its epoch-relative clock negative (violating the clock
+        # invariant even though results stay bit-exact under uniform shifts)
         st, acc_lo, acc_hi, base_lo, base_hi = jax.lax.cond(
             (k & 63) == 63,
-            lambda args: _drain_and_rebase(cfg, *args, ~at_end(args[0])),
+            lambda args: _drain_and_rebase(
+                cfg, *args, ~(at_end(args[0]) & exhausted)
+            ),
             lambda args: args,
             (st, acc_lo, acc_hi, base_lo, base_hi),
         )

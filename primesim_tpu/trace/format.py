@@ -238,32 +238,43 @@ def validate_sync(trace: Trace, barrier_slots: int) -> None:
 
 
 def scan_trace_meta(
-    trace: Trace, barrier_slots: int, rows_per_chunk: int = 256
+    trace: Trace,
+    barrier_slots: int,
+    max_chunk_records: int = 1 << 24,
 ) -> tuple[bool, int, bool]:
     """One bounded-memory pass over a (possibly memory-mapped) trace:
     returns (has_sync, max per-event instruction batch, any barrier id >=
-    barrier_slots). Chunked by core rows so peak host memory is
-    O(rows_per_chunk * max_len), never O(file) — the streaming engine's
-    whole point is traces bigger than RAM."""
+    barrier_slots). Tiled along BOTH axes with the tile sizes co-tuned so
+    one chunk holds at most `max_chunk_records` records (~256 MB at the
+    default), never O(file) — row-only chunking still materialized
+    rows * max_len records, which for a few-cores/very-long trace (the
+    streaming engine's target shape) could itself exceed RAM."""
     has_sync = False
     per_ev = 1
     bad_bid = False
+    events_per_chunk = min(trace.max_len, max_chunk_records)
+    rows_per_chunk = max(1, max_chunk_records // events_per_chunk)
     for lo in range(0, trace.n_cores, rows_per_chunk):
-        ev = np.asarray(trace.events[lo : lo + rows_per_chunk])
-        t = ev[:, :, 0]
-        if not has_sync:
-            has_sync = bool(
-                ((t == EV_LOCK) | (t == EV_UNLOCK) | (t == EV_BARRIER)).any()
+        for elo in range(0, trace.max_len, events_per_chunk):
+            ev = np.asarray(
+                trace.events[
+                    lo : lo + rows_per_chunk, elo : elo + events_per_chunk
+                ]
             )
-        per_ev = max(
-            per_ev,
-            int(ev[:, :, 1].max(initial=0)),
-            int(ev[:, :, 3].max(initial=0)) + 1,
-        )
-        if not bad_bid:
-            bad_bid = bool(
-                (ev[:, :, 2][t == EV_BARRIER] >= barrier_slots).any()
+            t = ev[:, :, 0]
+            if not has_sync:
+                has_sync = bool(
+                    ((t == EV_LOCK) | (t == EV_UNLOCK) | (t == EV_BARRIER)).any()
+                )
+            per_ev = max(
+                per_ev,
+                int(ev[:, :, 1].max(initial=0)),
+                int(ev[:, :, 3].max(initial=0)) + 1,
             )
+            if not bad_bid:
+                bad_bid = bool(
+                    (ev[:, :, 2][t == EV_BARRIER] >= barrier_slots).any()
+                )
     return has_sync, per_ev, bad_bid
 
 

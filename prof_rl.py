@@ -1,0 +1,19 @@
+"""Time run_chunk at the BENCH machine across local_run_len values — the
+number the perf work must move. Reuses prof_step's harness (one config
+builder + timing protocol; see the sync NOTE there).
+
+Usage: python prof_rl.py [rl ...]       (default: 0 8)
+"""
+import sys
+
+from prof_step import bench_cfg, time_chunk
+
+
+def main():
+    rls = [int(a) for a in sys.argv[1:]] or [0, 8]
+    for rl in rls:
+        time_chunk(bench_cfg(1024, local_run_len=rl), tag=f"rl={rl}")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,7 +12,7 @@ from primesim_tpu.trace import synth
 from primesim_tpu.trace.format import fold_ins
 
 
-def bench_cfg(C=1024, llc_kb=256):
+def bench_cfg(C=1024, llc_kb=256, **kw):
     return MachineConfig(
         n_cores=C,
         n_banks=C,
@@ -21,10 +21,11 @@ def bench_cfg(C=1024, llc_kb=256):
         noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
         dram_lat=100,
         quantum=1000,
+        **kw,
     )
 
 
-def time_chunk(cfg, n_steps=256, tag=""):
+def time_chunk(cfg, n_steps=256, tag="", has_sync=False):
     trace = fold_ins(synth.fft_like(cfg.n_cores, n_phases=4, points_per_core=256,
                                     ins_per_mem=8, seed=42))
     events = jnp.asarray(trace.line_events(cfg.line_bits))
@@ -32,14 +33,14 @@ def time_chunk(cfg, n_steps=256, tag=""):
     # NOTE: sync via an explicit host transfer (np.asarray of a leaf).
     # jax.block_until_ready on AOT-compiled outputs under-synced through
     # the remote-TPU tunnel and reported ~1000x-too-fast times (round 3).
-    st2 = run_chunk(cfg, n_steps, events, st)
+    st2 = run_chunk(cfg, n_steps, events, st, has_sync=has_sync)
     np.asarray(st2.step)
     t0 = time.perf_counter()
     for _ in range(3):
-        st2 = run_chunk(cfg, n_steps, events, st2)
+        st2 = run_chunk(cfg, n_steps, events, st2, has_sync=has_sync)
     np.asarray(st2.step)
     dt = (time.perf_counter() - t0) / 3 / n_steps
-    print(f"[{tag}] {dt*1e3:.3f} ms/step")
+    print(f"[{tag}] {dt*1e3:.3f} ms/step", flush=True)
     return dt
 
 
