@@ -7,7 +7,12 @@ butterfly exchanges), end to end through the chunked Engine (including
 host-side counter drains and termination checks).
 
 Prints ONE JSON line: simulated MIPS (million simulated target
-instructions per wall second).
+instructions per wall second). The headline metric is the plain
+1024-core machine; the detail additionally records the SHIPPED
+`configs/rung3_1024core_o3.json` machine (hop-by-hop router contention +
+O3 overlap — BASELINE config 3 "NoC-congestion heavy") measured the same
+way, so the official artifact covers both the fast path and the
+full-fidelity ladder rung.
 
 `vs_baseline` compares against 20 MIPS — the upper end of the reference
 simulator's published multi-host aggregate throughput (ISPASS'14 paper,
@@ -18,22 +23,52 @@ deliberately strong baseline: the whole reference cluster vs one TPU chip.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 BASELINE_MIPS = 20.0
 
 
-def main() -> None:
+def _measure(cfg, trace, chunk: int, runs: int = 3):
+    """Best-of-N timed Engine.run with compile warm-up and upload sync
+    outside the timed region (the shared measurement protocol)."""
     import numpy as np
-
-    from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
-    from primesim_tpu.sim.engine import Engine
-    from primesim_tpu.trace import synth
 
     import jax.numpy as jnp
 
+    from primesim_tpu.sim.engine import Engine, run_loop
+
+    warm = Engine(cfg, trace, chunk_steps=chunk)
+    out = run_loop(
+        cfg, chunk, warm.events, warm.state, jnp.asarray(1, jnp.int32),
+        has_sync=warm.has_sync,
+    )
+    np.asarray(out[0].cycles)  # block until compiled
+    walls = []
+    eng = None
+    for _ in range(runs):
+        eng = Engine(cfg, trace, chunk_steps=chunk)
+        eng.block_until_ready()  # don't bill async uploads to simulation
+        t0 = time.perf_counter()
+        eng.run(max_steps=10_000_000)
+        walls.append(time.perf_counter() - t0)
+    return eng, min(walls), walls
+
+
+def main() -> None:
+    import numpy as np
+
+    from primesim_tpu.config.machine import (
+        CacheConfig,
+        MachineConfig,
+        NocConfig,
+    )
+    from primesim_tpu.trace import synth
+    from primesim_tpu.trace.format import fold_ins
+
     C = 1024
-    CHUNK = 512
+    CHUNK = int(os.environ.get("PRIMETPU_BENCH_CHUNK", "512"))
+    RL = int(os.environ.get("PRIMETPU_BENCH_RL", "8"))
     cfg = MachineConfig(
         n_cores=C,
         n_banks=C,
@@ -42,44 +77,36 @@ def main() -> None:
         noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
         dram_lat=100,
         quantum=1000,
-        # swept on TPU with upload-synced timing (r4): rl 4 -> 4.27,
-        # 6 -> 4.24, 8 -> 4.72, 10 -> 4.20, 12 -> 3.82 MIPS
-        local_run_len=8,
+        local_run_len=RL,
     )
-    from primesim_tpu.trace.format import fold_ins
-
     trace = fold_ins(
         synth.fft_like(C, n_phases=4, points_per_core=256, ins_per_mem=8, seed=42)
     )
     n_instructions = trace.total_instructions()
 
-    # compile warm-up of the ACTUAL dispatch path (run_loop), one chunk at
-    # the measured shapes; the jit cache persists into the timed run
-    from primesim_tpu.sim.engine import run_loop
-
-    warm = Engine(cfg, trace, chunk_steps=CHUNK)
-    out = run_loop(
-        cfg, CHUNK, warm.events, warm.state, jnp.asarray(1, jnp.int32),
-        has_sync=warm.has_sync,  # warm the exact variant the run compiles
-    )
-    np.asarray(out[0].cycles)  # block
-
-    # best of three timed runs, each synced on its async uploads BEFORE
-    # the clock starts (a lazy multi-MB transfer through the remote-TPU
-    # tunnel otherwise lands inside the timed dispatch — that, not device
-    # compute, was the round-4 "+-30% jitter"); the fastest run is the
-    # truer device-rate measurement
-    walls = []
-    for _ in range(3):
-        eng = Engine(cfg, trace, chunk_steps=CHUNK)
-        eng.block_until_ready()
-        t0 = time.perf_counter()
-        eng.run(max_steps=10_000_000)
-        walls.append(time.perf_counter() - t0)
-    wall = min(walls)
-
+    eng, wall, walls = _measure(cfg, trace, CHUNK)
     mips = n_instructions / wall / 1e6
     agg_cycles = int(np.asarray(eng.cycles).max())
+
+    # second recorded metric: the SHIPPED rung-3 config (router NoC + O3)
+    detail_r3 = {}
+    r3_path = os.path.join(os.path.dirname(__file__), "configs",
+                           "rung3_1024core_o3.json")
+    with open(r3_path) as f:
+        cfg3 = MachineConfig.from_json(f.read())
+    eng3, wall3, _ = _measure(cfg3, trace, CHUNK, runs=2)
+    detail_r3 = {
+        "config": "configs/rung3_1024core_o3.json",
+        "contention_model": cfg3.noc.contention_model,
+        "dram_queue": cfg3.dram_queue,
+        "mips": round(n_instructions / wall3 / 1e6, 3),
+        "wall_s": round(wall3, 2),
+        "noc_contention_cycles": int(
+            eng3.counters["noc_contention_cycles"].sum()
+        ),
+        "dram_queue_cycles": int(eng3.counters["dram_queue_cycles"].sum()),
+    }
+
     print(
         json.dumps(
             {
@@ -96,16 +123,9 @@ def main() -> None:
                     "max_core_cycles": agg_cycles,
                     "sim_cycles_per_s": round(agg_cycles / wall),
                     "noc_msgs": int(eng.counters["noc_msgs"].sum()),
-                    # STATIC RECORD, not part of this run: the round-4
-                    # tuning sweeps measured on TPU 2026-07-30 with
-                    # upload-synced timing (best-of-2 each), justifying
-                    # the rl=8 / chunk=512 defaults above
-                    "sweep_mips_static_r4_2026_07_30": {
-                        "rl4": 4.265, "rl6": 4.236, "rl8": 4.717,
-                        "rl10": 4.195, "rl12": 3.819,
-                        "chunk128": 4.775, "chunk256": 4.796,
-                        "chunk512": 4.808, "chunk1024": 3.704,
-                    },
+                    "local_run_len": RL,
+                    "chunk_steps": CHUNK,
+                    "rung3_shipped_config": detail_r3,
                 },
             }
         )

@@ -84,10 +84,10 @@ def cmd_run(ns) -> int:
         )
 
     if ns.engine == "golden":
-        if ns.xprof or ns.debug_invariants or ns.stream_window:
+        if ns.xprof or ns.debug_invariants or ns.stream_window or ns.devices:
             raise SystemExit(
-                "--xprof/--debug-invariants/--stream-window require "
-                "--engine jax (the golden oracle has no device loop)"
+                "--xprof/--debug-invariants/--stream-window/--devices "
+                "require --engine jax (the golden oracle has no device loop)"
             )
         from ..golden.sim import GoldenSim
 
@@ -101,10 +101,10 @@ def cmd_run(ns) -> int:
         # host O(1) with --mmap; bit-exact vs the preloaded engine
         from ..ingest.stream import StreamEngine
 
-        if ns.xprof or ns.debug_invariants:
+        if ns.xprof or ns.debug_invariants or ns.devices:
             raise SystemExit(
-                "--xprof/--debug-invariants are not supported with "
-                "--stream-window yet"
+                "--xprof/--debug-invariants/--devices are not supported "
+                "with --stream-window yet"
             )
         eng = StreamEngine(cfg, tr, window_events=ns.stream_window)
         # warm the jit cache at the run's window shapes so the reported
@@ -123,13 +123,28 @@ def cmd_run(ns) -> int:
 
         from ..sim.engine import Engine, run_chunk, run_loop
 
+        mesh = None
+        if ns.devices:
+            # multi-chip: shard cores/L1s/events by core and the LLC/
+            # directory by bank over the first N visible devices (virtual
+            # CPU meshes work too: XLA_FLAGS=--xla_force_host_platform_
+            # device_count=N JAX_PLATFORMS=cpu)
+            from ..parallel.sharding import tile_mesh
+
+            mesh = tile_mesh(ns.devices)
+            print(
+                f"mesh: {ns.devices} devices "
+                f"({mesh.devices.flat[0].platform})",
+                file=sys.stderr,
+            )
+
         # warm the jit cache at the measured shapes (one chunk) so the
         # reported MIPS measures simulation, not compilation — the same
         # protocol as bench.py; comparable numbers matter more than the
         # one-off compile cost shown to an interactive user. The debug
         # path dispatches run_chunk, not the fused run_loop — warm the
         # function the run will actually use.
-        warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
+        warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
         if ns.debug_invariants:
             out = run_chunk(
                 cfg, ns.chunk_steps, warm.events, warm.state,
@@ -142,7 +157,7 @@ def cmd_run(ns) -> int:
                 jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
             )
             np.asarray(out[0].cycles)
-        eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps)
+        eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
         eng.block_until_ready()  # don't bill async uploads to simulation
 
         def _go():
@@ -246,6 +261,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--mmap", action="store_true",
         help="memory-map the trace file (pair with --stream-window for "
              "traces larger than host memory)",
+    )
+    r.add_argument(
+        "--devices", type=int, default=0, metavar="N",
+        help="shard the simulated machine over the first N jax devices "
+             "(cores/L1s by core, LLC/directory by bank; jax engine)",
     )
     r.set_defaults(fn=cmd_run)
 

@@ -108,8 +108,23 @@ class NocConfig:
       bottleneck-link queue. This makes path-crossing traffic contend
       even when home banks differ (BASELINE rung 3 "NoC-congestion
       heavy").
+    - ``"router"`` — hop-by-hop router with PER-LINK QUEUE STATE CARRIED
+      ACROSS STEPS (SURVEY.md §2 #6's hop-by-hop `Network` router): every
+      directed link keeps a next-free-cycle clock (`MachineState.
+      link_free`). A transaction's packet walks its XY route hop by hop:
+      at each link it waits for `link_free + rank*link_lat` (rank =
+      number of same-step packets on that link injected earlier in the
+      canonical (clock, core) order — FIFO serialization at `link_lat`
+      per packet), then occupies the link for `link_lat` and pays
+      `router_lat` at the next router; waits cascade into later hops.
+      After the step, each link's clock advances to its last departure.
+      Uncontended, the walk reduces exactly to the analytic
+      `hops*link_lat + (hops+1)*router_lat`. Probe/invalidation side
+      legs keep analytic latency (model scope: request/reply/barrier
+      arrival paths route through the queues). `contention_lat` is
+      unused by this model.
 
-    Both models are implemented identically in the golden and JAX engines
+    All models are implemented identically in the golden and JAX engines
     and charged before the O3 overlap reduction.
     """
 
@@ -118,7 +133,7 @@ class NocConfig:
     link_lat: int = 1  # per-hop link traversal, cycles
     router_lat: int = 1  # per-router, cycles ((hops+1) routers on a path)
     contention: bool = False
-    contention_model: str = "tile"  # "tile" | "link"
+    contention_model: str = "tile"  # "tile" | "link" | "router"
     contention_lat: int = 1  # queueing cycles per concurrent transaction
 
     @property
@@ -137,6 +152,19 @@ class MachineConfig:
     n_banks: int = 64
     noc: NocConfig = field(default_factory=NocConfig)
     dram_lat: int = 100
+    # Memory-controller queueing (SURVEY.md §2 #7's "later: queueing
+    # model per controller"): each LLC bank's co-located controller keeps
+    # a next-free clock carried across steps; a miss whose request
+    # arrives while the controller is busy waits for
+    # `max(dram_free[bank], base) + rank*dram_service` (rank = earlier
+    # same-step misses to the bank in (clock, core) order, base = the
+    # bank's earliest nominal arrival this step — the same FIFO shape as
+    # the router NoC model). `dram_service` is the controller occupancy
+    # per access (0 -> dram_lat, a fully serialized controller). Waits
+    # are charged before the O3 reduction and counted in
+    # `dram_queue_cycles`; golden and engine are bit-exact.
+    dram_queue: bool = False
+    dram_service: int = 0
     quantum: int = 1000  # relaxed-sync quantum, cycles (the fidelity/speed knob)
     # Local-run length: how many LOCAL events (INS batches, L1 hits) each
     # core may retire per step BEFORE the one arbitrated uncore event
@@ -156,8 +184,21 @@ class MachineConfig:
     # back-invalidation reductions (fastest at <= 1024 cores); K > 0 =
     # lax.scan over K-word blocks of the packed sharer words, bounding
     # per-step temporaries to [C, 32K] instead of [C, C] (4096+ cores).
-    # Bit-exact either way. K must divide ceil(n_cores / 32).
+    # Bit-exact either way. K must divide n_sharer_words.
     sharer_chunk_words: int = 0
+    # COARSE SHARER VECTOR (Dir-G; SURVEY.md §2 #4, BASELINE rung 5): each
+    # directory bit covers a GROUP of `sharer_group` consecutive cores,
+    # dividing sharer storage by G — the full-map vector at 16384 cores x
+    # 16.8M entries is 256 GiB, impossible on any chip; G=64 makes it
+    # ~1 GiB. 1 = exact full-map. G > 1 is CONSERVATIVE, the classic
+    # coarse-vector trade (Gupta et al.): invalidations broadcast to every
+    # core of each flagged group (the requester is skipped as a message
+    # but still bounds the serialization latency), a line is exclusive
+    # (E-grantable) only when NO group bit is set, and read-join
+    # coalescing is disabled (same-group joiners' bit updates would not
+    # commute). Both engines implement the identical model; parity is
+    # proven at small scale with G in {4, 32} (tests/test_coarse.py).
+    sharer_group: int = 1
 
     def __post_init__(self):
         self.validate()
@@ -176,12 +217,16 @@ class MachineConfig:
             raise ValueError("quantum must be positive")
         if self.dram_lat < 0:
             raise ValueError("dram_lat must be >= 0")
+        if self.dram_service < 0:
+            raise ValueError("dram_service must be >= 0")
         if self.noc.link_lat < 0 or self.noc.router_lat < 0:
             raise ValueError("NoC latencies must be >= 0")
         if self.noc.contention_lat < 0:
             raise ValueError("contention_lat must be >= 0")
-        if self.noc.contention_model not in ("tile", "link"):
-            raise ValueError("contention_model must be 'tile' or 'link'")
+        if self.noc.contention_model not in ("tile", "link", "router"):
+            raise ValueError(
+                "contention_model must be 'tile', 'link' or 'router'"
+            )
         if self.noc.mesh_x < 1 or self.noc.mesh_y < 1:
             raise ValueError("mesh dims must be >= 1")
         if not (0 <= self.local_run_len <= 64):
@@ -190,6 +235,8 @@ class MachineConfig:
             raise ValueError("lock_slots must be a power of two")
         if not _is_pow2(self.barrier_slots):
             raise ValueError("barrier_slots must be a power of two")
+        if not _is_pow2(self.sharer_group):
+            raise ValueError("sharer_group must be a power of two >= 1")
         if self.sharer_chunk_words < 0:
             raise ValueError("sharer_chunk_words must be >= 0")
         if self.sharer_chunk_words and (
@@ -207,8 +254,12 @@ class MachineConfig:
         return self.l1.line.bit_length() - 1
 
     @property
+    def n_sharer_groups(self) -> int:
+        return (self.n_cores + self.sharer_group - 1) // self.sharer_group
+
+    @property
     def n_sharer_words(self) -> int:
-        return (self.n_cores + 31) // 32
+        return (self.n_sharer_groups + 31) // 32
 
     @property
     def n_tiles(self) -> int:

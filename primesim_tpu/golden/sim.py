@@ -17,7 +17,14 @@ from __future__ import annotations
 import numpy as np
 
 from ..config.machine import MachineConfig
-from ..noc.mesh import bank_tile, core_tile, hops as _hops, one_way_lat, xy_links
+from ..noc.mesh import (
+    bank_tile,
+    core_tile,
+    hops as _hops,
+    n_links,
+    one_way_lat,
+    xy_links,
+)
 from ..stats.counters import zero_counters
 from ..trace.format import (
     EV_BARRIER,
@@ -64,6 +71,13 @@ class GoldenSim:
         self.quantum_end = cfg.quantum
         self.step_count = 0
 
+        # hop-by-hop router model: per-directed-link next-free clock,
+        # carried across steps (contention_model="router")
+        self.link_free = np.zeros(n_links(cfg), dtype=np.int64)
+        # memory-controller queueing (cfg.dram_queue): per-bank next-free
+        # clock, carried across steps
+        self.dram_free = np.zeros(B, dtype=np.int64)
+
         # synchronization state (DESIGN.md §3 phase 2.7)
         self.lock_holder = np.full(cfg.lock_slots, -1, dtype=np.int64)
         self.barrier_count = np.zeros(cfg.barrier_slots, dtype=np.int64)
@@ -90,7 +104,10 @@ class GoldenSim:
         return int(np.argmin(key))
 
     def _set_sharer(self, b, s, w, core, val: bool):
-        wi, bit = core // 32, core % 32
+        # coarse vector (cfg.sharer_group > 1): the bit covers the whole
+        # group of cores `core` belongs to
+        g = core // self.cfg.sharer_group
+        wi, bit = g // 32, g % 32
         if val:
             self.sharers[b, s, w, wi] |= np.uint32(1 << bit)
         else:
@@ -126,9 +143,13 @@ class GoldenSim:
         """Queueing charge for core c's transaction from `ctile` to home
         `htile` this step (0 when the model is disabled). Tile model:
         occupancy at the home tile; link model: bottleneck occupancy over
-        the transaction's XY path links."""
+        the transaction's XY path links. The router model charges through
+        `_route` instead (this returns 0 so analytic compositions stay
+        clean and the router surcharge replaces them wholesale)."""
         cfg = self.cfg
         if not cfg.noc.contention:
+            return 0
+        if cfg.noc.contention_model == "router":
             return 0
         if cfg.noc.contention_model == "tile":
             extra = cfg.noc.contention_lat * (self._tile_txns.get(htile, 1) - 1)
@@ -139,6 +160,61 @@ class GoldenSim:
             extra = cfg.noc.contention_lat * worst
         self.counters["noc_contention_cycles"][c] += extra
         return extra
+
+    # ------------------------------------------ hop-by-hop router model
+
+    @property
+    def _router_on(self) -> bool:
+        return (
+            self.cfg.noc.contention
+            and self.cfg.noc.contention_model == "router"
+        )
+
+    def _rtr_rank(self, link: int, key) -> int:
+        """FIFO position among this step's packets on `link`: how many
+        same-step transactions with a smaller (clock, core) key also
+        traverse it. Fixed at step entry — every transaction's charge
+        depends only on carried link clocks, the step's fixed rank/anchor
+        tables, and its own timings, which is what makes the vectorized
+        engine bit-exact."""
+        return sum(1 for k in self._rtr_users.get(link, ()) if k < key)
+
+    def _route(self, t0: int, path, key) -> int:
+        """Walk one packet over `path` hop by hop against the carried
+        per-link clocks: at each link wait for
+        `max(link_free, base) + rank*link_lat` — `base` is the link's
+        EARLIEST NOMINAL (uncontended) arrival among this step's packets,
+        so same-step FIFO serialization anchors at when the link's queue
+        actually starts forming, not at a long-idle link clock — then
+        occupy the link for link_lat and pay router_lat at the next
+        router; waits cascade into later hops. Records each departure for
+        the end-of-step clock advance. Returns the arrival time;
+        uncontended this is exactly t0 + hops*link_lat +
+        (hops+1)*router_lat (the analytic one-way)."""
+        noc = self.cfg.noc
+        t = t0 + noc.router_lat
+        for l in path:
+            rank = self._rtr_rank(l, key)
+            anchor = max(int(self.link_free[l]), self._rtr_base.get(l, 0))
+            t = max(t, anchor + rank * noc.link_lat)
+            self._rtr_departs.append((l, t + noc.link_lat))
+            t += noc.link_lat + noc.router_lat
+        return t
+
+    def _route_rt(self, c: int, t0: int, htile: int, service: int) -> int:
+        """Round-trip request->service->reply through the router, keyed
+        by core c's recorded step-entry key. Returns completion time."""
+        mx = self.cfg.noc.mesh_x
+        ctile = core_tile(c, self.cfg)
+        key = self._rtr_key[c]
+        t = self._route(t0, xy_links(ctile, htile, mx), key)
+        return self._route(t + service, xy_links(htile, ctile, mx), key)
+
+    def _rtr_end(self) -> None:
+        for l, d in self._rtr_departs:
+            if d > self.link_free[l]:
+                self.link_free[l] = d
+        self._rtr_departs = []
 
     # --------------------------------------------------------------- step
 
@@ -347,30 +423,130 @@ class GoldenSim:
         # lock/unlock RMWs (lock home), barrier arrivals (barrier home).
         self._tile_txns = {}
         self._link_cnt = {}
+        self._rtr_users = {}
+        self._rtr_base = {}
+        self._rtr_key = {}
+        self._rtr_departs = []
         if cfg.noc.contention:
             link_model = cfg.noc.contention_model == "link"
+            router = cfg.noc.contention_model == "router"
+            mx = cfg.noc.mesh_x
+            c_hop = cfg.noc.link_lat + cfg.noc.router_lat
+            r_lat = cfg.noc.router_lat
 
-            def _bump(c, htile, round_trip=True):
-                if link_model:
+            def _bump(c, htile, round_trip=True, key=None, t0=0):
+                if router:
+                    # record this packet's links, canonical key, and
+                    # NOMINAL (uncontended) per-link arrival times; ranks
+                    # and queue anchors are computed against this fixed
+                    # set. Reply-leg nominals assume llc.latency service
+                    # (the model's defined anchor — the real service may
+                    # be longer; `base` is a min, so early is safe).
+                    self._rtr_key[c] = key
+                    ctile = core_tile(c, cfg)
+                    req = xy_links(ctile, htile, mx)
+                    legs = [(req, t0)]
+                    if round_trip:
+                        legs.append(
+                            (
+                                xy_links(htile, ctile, mx),
+                                t0
+                                + r_lat
+                                + len(req) * c_hop
+                                + cfg.llc.latency,
+                            )
+                        )
+                    seen = set()
+                    for path, leg_t0 in legs:
+                        for k, l in enumerate(path):
+                            a = leg_t0 + r_lat + k * c_hop
+                            if (b := self._rtr_base.get(l)) is None or a < b:
+                                self._rtr_base[l] = a
+                            if l not in seen:
+                                seen.add(l)
+                                self._rtr_users.setdefault(l, []).append(key)
+                elif link_model:
                     ctile = core_tile(c, cfg)
                     for l in self._txn_path(ctile, htile, round_trip):
                         self._link_cnt[l] = self._link_cnt.get(l, 0) + 1
                 else:
                     self._tile_txns[htile] = self._tile_txns.get(htile, 0) + 1
 
-            for _, c, _, line, _ in winners:
-                _bump(c, bank_tile(self._bank(line), cfg))
-            for c, line, _ in join_go:
-                _bump(c, bank_tile(self._bank(line), cfg))
-            for c, addr, _ in unlocks:
-                _bump(c, self._lock_home_tile(addr))
-            for _, c, addr, _ in lock_reqs:
-                _bump(c, self._lock_home_tile(addr))
-            for c, bid, _, _ in barrier_arr:
-                _bump(c, bid % cfg.n_tiles, round_trip=False)
+            l1lat = cfg.l1.latency
+            for cyc, c, _, line, pre in winners:
+                _bump(
+                    c,
+                    bank_tile(self._bank(line), cfg),
+                    key=(cyc, c),
+                    t0=cyc + pre * int(self.cpi[c]) + l1lat,
+                )
+            for c, line, pre in join_go:
+                cy = int(self.cycles[c])
+                _bump(
+                    c,
+                    bank_tile(self._bank(line), cfg),
+                    key=(cy, c),
+                    t0=cy + pre * int(self.cpi[c]) + l1lat,
+                )
+            for c, addr, pre in unlocks:
+                cy = int(self.cycles[c])
+                _bump(
+                    c,
+                    self._lock_home_tile(addr),
+                    key=(cy, c),
+                    t0=cy + pre * int(self.cpi[c]),
+                )
+            for cyc, c, addr, pre in lock_reqs:
+                first = self.sync_flag[c] == 0
+                _bump(
+                    c,
+                    self._lock_home_tile(addr),
+                    key=(cyc, c),
+                    t0=cyc + (pre * int(self.cpi[c]) if first else 0),
+                )
+            for c, bid, _, pre in barrier_arr:
+                cy = int(self.cycles[c])
+                _bump(
+                    c,
+                    bid % cfg.n_tiles,
+                    round_trip=False,
+                    key=(cy, c),
+                    t0=cy + pre * int(self.cpi[c]),
+                )
 
         for c, line, pre in join_go:
             self._do_join(c, line, pre, step)
+
+        # --- memory-controller queue pre-pass (cfg.dram_queue) -------------
+        # This step's DRAM transactions (miss winners) and their NOMINAL
+        # controller arrivals are fixed BEFORE any winner is processed, so
+        # ranks/anchors are step-scoped exactly like the router model's;
+        # the per-slot uniqueness of winners makes the hit peek identical
+        # to the processing-time lookup.
+        self._dram_users = {}
+        self._dram_base = {}
+        self._dram_arr = {}
+        self._dram_starts = []
+        if cfg.dram_queue:
+            svc = cfg.dram_service or cfg.dram_lat
+            for cyc, c, kind, line, pre in winners:
+                b, bs = self._bank(line), self._bank_set(line)
+                if any(
+                    self.llc_tag[b, bs, w] == line
+                    for w in range(cfg.llc.ways)
+                ):
+                    continue  # LLC hit: no controller access
+                a = (
+                    cyc
+                    + pre * int(self.cpi[c])
+                    + cfg.l1.latency
+                    + one_way_lat(core_tile(c, cfg), bank_tile(b, cfg), cfg)
+                    + cfg.llc.latency
+                )
+                self._dram_users.setdefault(b, []).append((cyc, c))
+                self._dram_arr[c] = a
+                if b not in self._dram_base or a < self._dram_base[b]:
+                    self._dram_base[b] = a
 
         # --- phase 3: transitions on step-start state; collect phase-B ops -
         # Phase-B op = (core, line, op) with op in {"downgrade","invalidate"}
@@ -404,11 +580,16 @@ class GoldenSim:
                 self.counters["llc_hits"][c] += 1
                 w = hitw
                 owner = int(self.llc_owner[b, bs, w])
-                shl = [
-                    t
-                    for t in self._sharers_from(self.sharers, b, bs, w)
-                    if t != c
-                ]
+                recorded = self._sharers_from(self.sharers, b, bs, w)
+                shl = [t for t in recorded if t != c]
+                # coarse vector: "shared" means ANY group bit is set —
+                # the requester's own group bit may cover other cores, so
+                # exclusivity requires an empty vector
+                shared_any = (
+                    bool(shl)
+                    if cfg.sharer_group == 1
+                    else self._any_sharer_bit(b, bs, w)
+                )
                 if kind == GETS:
                     if owner >= 0 and owner != c:
                         # probe owner (charged regardless of staleness)
@@ -429,7 +610,7 @@ class GoldenSim:
                         # owner's private cache state.
                         self._set_sharer(b, bs, w, owner, True)
                         grant = S
-                    elif shl:
+                    elif shared_any:
                         self._set_sharer(b, bs, w, c, True)
                         grant = S
                     else:
@@ -444,10 +625,18 @@ class GoldenSim:
                         lat += self._noc(c, otile, btile)
                         self.counters["probes"][c] += 1
                         phase_b.append((owner, line, "invalidate"))
-                    for tcore in shl:
+                    # serialization latency spans every RECORDED core of
+                    # flagged groups (coarse mode: including the
+                    # requester's own slot — the home node serializes the
+                    # whole group broadcast); messages/counters/phase-B
+                    # go to the recorded cores minus the requester
+                    for tcore in recorded:
                         ttile = core_tile(tcore, cfg)
                         rt = one_way_lat(btile, ttile, cfg) * 2
-                        inv_lat = max(inv_lat, rt)
+                        if cfg.sharer_group > 1 or tcore != c:
+                            inv_lat = max(inv_lat, rt)
+                    for tcore in shl:
+                        ttile = core_tile(tcore, cfg)
                         self.counters["invalidations"][c] += 1
                         self.counters["noc_msgs"][c] += 2
                         self.counters["noc_hops"][c] += 2 * _hops(
@@ -464,6 +653,21 @@ class GoldenSim:
                 self.counters["llc_misses"][c] += 1
                 self.counters["dram_accesses"][c] += 1
                 self.counters["noc_msgs"][c] += 2  # to co-located controller
+                if cfg.dram_queue:
+                    svc = cfg.dram_service or cfg.dram_lat
+                    bkey = (cyc, c)
+                    rank = sum(
+                        1 for k in self._dram_users.get(b, ()) if k < bkey
+                    )
+                    a = self._dram_arr[c]
+                    start = max(
+                        a,
+                        max(int(self.dram_free[b]), self._dram_base[b])
+                        + rank * svc,
+                    )
+                    self.counters["dram_queue_cycles"][c] += start - a
+                    lat += start - a
+                    self._dram_starts.append((b, start + svc))
                 lat += cfg.dram_lat
                 # victim selection on step-start state
                 w = self._victim_way(
@@ -500,6 +704,19 @@ class GoldenSim:
 
             lat += self._noc(c, btile, ctile)  # reply
             lat += self._contention_extra(c, ctile, btile)
+
+            if self._router_on:
+                # replace the analytic request/reply legs with the hop-by
+                # -hop walk; everything between them (LLC, probes,
+                # invalidations, DRAM) is the service interval
+                req_a = one_way_lat(ctile, btile, cfg)
+                rep_a = one_way_lat(btile, ctile, cfg)
+                service = lat - cfg.l1.latency - req_a - rep_a
+                t0 = cyc + pre * int(self.cpi[c]) + cfg.l1.latency
+                t_end = self._route_rt(c, t0, btile, service)
+                raw = cfg.l1.latency + (t_end - t0)
+                self.counters["noc_contention_cycles"][c] += raw - lat
+                lat = raw
 
             # O3-style overlap: hide a fraction of the miss latency
             ov = cfg.core.o3_overlap_256
@@ -555,6 +772,11 @@ class GoldenSim:
             ctile = core_tile(c, cfg)
             lat = self._noc(c, ctile, h) + cfg.llc.latency + self._noc(c, h, ctile)
             lat += self._contention_extra(c, ctile, h)
+            if self._router_on:
+                t0 = int(self.cycles[c]) + pre * int(self.cpi[c])
+                t_end = self._route_rt(c, t0, h, cfg.llc.latency)
+                self.counters["noc_contention_cycles"][c] += (t_end - t0) - lat
+                lat = t_end - t0
             self.cycles[c] += pre * int(self.cpi[c]) + lat
             self.counters["instructions"][c] += pre + 1
             if self.lock_holder[s] == c:
@@ -576,6 +798,15 @@ class GoldenSim:
                     + self._noc(c, h, ctile)
                 )
                 lat += self._contention_extra(c, ctile, h)
+                if self._router_on:
+                    t0 = int(self.cycles[c]) + (
+                        pre * int(self.cpi[c]) if self.sync_flag[c] == 0 else 0
+                    )
+                    t_end = self._route_rt(c, t0, h, cfg.llc.latency)
+                    self.counters["noc_contention_cycles"][c] += (
+                        t_end - t0
+                    ) - lat
+                    lat = t_end - t0
                 if self.sync_flag[c] == 0:  # first attempt: charge pre batch
                     self.cycles[c] += pre * int(self.cpi[c])
                     self.counters["instructions"][c] += pre
@@ -596,7 +827,19 @@ class GoldenSim:
             ctile = core_tile(c, cfg)
             self.cycles[c] += pre * int(self.cpi[c])
             self.counters["instructions"][c] += pre
-            self.cycles[c] += self._noc(c, ctile, h)  # arrival message
+            arr_lat = self._noc(c, ctile, h)  # arrival message
+            if self._router_on:
+                t0 = int(self.cycles[c])
+                t_end = self._route(
+                    t0,
+                    xy_links(ctile, h, cfg.noc.mesh_x),
+                    self._rtr_key[c],
+                )
+                self.counters["noc_contention_cycles"][c] += (
+                    t_end - t0
+                ) - arr_lat
+                arr_lat = t_end - t0
+            self.cycles[c] += arr_lat
             self.cycles[c] += self._contention_extra(c, ctile, h, round_trip=False)
             self.counters["barrier_waits"][c] += 1
             self.sync_flag[c] = 1
@@ -628,13 +871,27 @@ class GoldenSim:
                 self.barrier_count[bid] = 0
                 self.barrier_time[bid] = 0
 
+        # hop-by-hop router: advance each touched link's clock to its
+        # last departure (deferred to step end so every transaction
+        # charged this step saw the same carried link state)
+        if self._router_on:
+            self._rtr_end()
+        for b, d in self._dram_starts:
+            if d > self.dram_free[b]:
+                self.dram_free[b] = d
+
     # ------------------------------------------------------ read-join path
 
     def _join_eligible(self, c: int, line: int) -> bool:
         """GETS may coalesce iff the line is LLC-resident, ownerless, and
         already shared by someone else (DESIGN.md §3 'plain join' case —
         the only transition whose outcome and latency are independent of
-        concurrent same-line readers)."""
+        concurrent same-line readers). Disabled under the coarse sharer
+        vector: two same-group joiners' bit updates would collide in the
+        engine's single fused scatter-add (and coarse 'shared' cannot
+        distinguish self-only anyway)."""
+        if self.cfg.sharer_group > 1:
+            return False
         b, bs = self._bank(line), self._bank_set(line)
         for wy in range(self.cfg.llc.ways):
             if self.llc_tag[b, bs, wy] == line:
@@ -664,6 +921,15 @@ class GoldenSim:
         self.llc_lru[b, bs, w] = step
         lat += self._noc(c, btile, ctile)
         lat += self._contention_extra(c, ctile, btile)
+        if self._router_on:
+            req_a = one_way_lat(ctile, btile, cfg)
+            rep_a = one_way_lat(btile, ctile, cfg)
+            service = lat - cfg.l1.latency - req_a - rep_a  # llc.latency
+            t0 = int(self.cycles[c]) + pre * int(self.cpi[c]) + cfg.l1.latency
+            t_end = self._route_rt(c, t0, btile, service)
+            raw = cfg.l1.latency + (t_end - t0)
+            self.counters["noc_contention_cycles"][c] += raw - lat
+            lat = raw
         ov = cfg.core.o3_overlap_256
         if ov:
             lat = lat - ((lat * ov) >> 8)
@@ -689,13 +955,25 @@ class GoldenSim:
         return [I if llc_tag0[b, bs, w] == -1 else S for w in range(self.cfg.llc.ways)]
 
     def _sharers_from(self, sharers0, b, s, w) -> list[int]:
+        """RECORDED sharer cores of an entry: with the full-map vector,
+        exactly the cores whose bits are set; with a coarse vector
+        (sharer_group > 1), every core of every flagged group — the
+        conservative superset the directory actually knows."""
+        G = self.cfg.sharer_group
+        C = self.cfg.n_cores
         out = []
         for wi in range(sharers0.shape[3]):
             word = int(sharers0[b, s, w, wi])
             for bit in range(32):
                 if word & (1 << bit):
-                    out.append(wi * 32 + bit)
+                    g = wi * 32 + bit
+                    out.extend(
+                        t for t in range(g * G, min((g + 1) * G, C))
+                    )
         return out
+
+    def _any_sharer_bit(self, b, s, w) -> bool:
+        return bool(self.sharers[b, s, w].any())
 
     # ----------------------------------------------------------------- run
 

@@ -78,3 +78,60 @@ def capture_run(
     finally:
         if tmp is not None and os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def capture_online(
+    cmd: list[str],
+    *,
+    n_cores: int,
+    ring_path: str | None = None,
+    capture_memops: bool = True,
+    line: int = 64,
+    max_cores: int = 256,
+    ring_records: int = 1 << 16,
+    memop_max_lines: int = 64,
+    retain_history: bool = True,
+    env: dict[str, str] | None = None,
+):
+    """ONLINE execution-driven mode (SURVEY.md §2 #9): launch `cmd` under
+    the capture shim in shared-memory-ring mode and return
+    (process, RingSource) — feed the source to `ingest.ring.OnlineEngine`
+    to simulate WHILE the target runs. The caller owns both: wait() the
+    process and close() the source when the simulation returns.
+    """
+    from .ring import RingSource
+
+    so = build_shim()
+    if ring_path is None:
+        fd, ring_path = tempfile.mkstemp(suffix=".ptpuring")
+        os.close(fd)
+    run_env = dict(os.environ if env is None else env)
+    preload = run_env.get("LD_PRELOAD", "")
+    run_env.update(
+        LD_PRELOAD=(so + (" " + preload if preload else "")),
+        PTPU_RING_OUT=ring_path,
+        PTPU_RING_RECORDS=str(ring_records),
+        PTPU_CAPTURE_MEMOPS="1" if capture_memops else "0",
+        PTPU_LINE=str(line),
+        PTPU_MAX_CORES=str(max_cores),
+        PTPU_MEMOP_MAX_LINES=str(memop_max_lines),
+    )
+    proc = subprocess.Popen(
+        cmd, env=run_env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        # the mkstemp ring file is ours: RingSource.close() unlinks it.
+        # retain_history keeps the full stream for to_trace() replay
+        # comparisons; pass False for billion-event production captures
+        # (memory then stays bounded by the unconsumed backlog).
+        src = RingSource(
+            ring_path,
+            n_cores,
+            unlink_on_close=True,
+            retain_history=retain_history,
+        )
+    except Exception:
+        proc.kill()
+        raise
+    return proc, src

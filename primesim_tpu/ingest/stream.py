@@ -32,6 +32,39 @@ from ..sim.state import init_state
 from ..trace.format import EV_END, Trace, scan_trace_meta
 
 
+def absorb_stream_outputs(eng, out, buf):
+    """Fold one `stream_loop` dispatch's outputs into a streaming
+    engine's host accumulators (64-bit counter fold with the _ACC_BITS
+    carry, cycle-base advance, cursor advance) — the ONE implementation
+    of the drain protocol, shared by StreamEngine and the online
+    ring-fed engine so the two can never diverge. Returns
+    (steps_executed, consumed, at_end_mask)."""
+    import jax.numpy as jnp
+
+    st, acc_lo, acc_hi, base_lo, base_hi, k = out
+    acc = (
+        (np.asarray(acc_hi).astype(np.int64) << _ACC_BITS)
+        + np.asarray(acc_lo).astype(np.int64)
+        + np.asarray(st.counters).astype(np.int64)
+    )
+    for i, name in enumerate(COUNTER_NAMES):
+        eng.host_counters[name] += acc[i]
+    eng.cycle_base += (
+        np.int64(np.asarray(base_hi)) << _ACC_BITS
+    ) + np.int64(np.asarray(base_lo))
+    st = st._replace(counters=jnp.zeros_like(st.counters))
+    consumed = np.asarray(st.ptr).astype(np.int64)
+    k_int = int(np.asarray(k))
+    eng.steps_run += k_int
+    eng.state = st
+    at_end = (
+        buf[np.arange(eng.cfg.n_cores), np.minimum(consumed, eng.W), 0]
+        == EV_END
+    )
+    eng.cursor += consumed
+    return k_int, consumed, at_end
+
+
 class StreamEngine:
     """Bounded-memory streaming runner; results bit-exact vs Engine.run."""
 
@@ -130,50 +163,47 @@ class StreamEngine:
         )
         np.asarray(out[0].cycles)  # block until compiled
 
+    def _advance_window(self, budget: int) -> tuple[int, bool]:
+        """Dispatch ONE windowed device loop: fill, simulate until some
+        core's window runs low, drain counters, advance cursors. Returns
+        (steps executed, finished). After it returns, the engine is at a
+        CONSISTENT CUT — cursors and state fully describe the run — which
+        is what makes streaming checkpoints possible."""
+        cfg = self.cfg
+        C = cfg.n_cores
+        buf, exhausted, filled = self._fill_window()
+        st = self.state._replace(ptr=jnp.zeros(C, jnp.int32))
+        out = stream_loop(
+            cfg,
+            jnp.asarray(buf),
+            st,
+            jnp.asarray(exhausted),
+            jnp.asarray(filled),
+            jnp.asarray(min(budget, 2**31 - 1), jnp.int32),
+            has_sync=self.has_sync,
+        )
+        k_int, consumed, at_end = absorb_stream_outputs(self, out, buf)
+        finished = bool((at_end & exhausted).all())
+        if not finished and k_int == 0 and not consumed.any():
+            raise RuntimeError(
+                "stream engine: no progress in a window (window_events "
+                "too small for this trace shape?)"
+            )
+        return k_int, finished
+
+    def _default_budget(self) -> int:
+        return max(10_000_000, 64 * int(self.real_len.sum()))
+
     def run(self, max_steps: int | None = None) -> None:
         """Stream to completion. `max_steps` defaults to a budget derived
         from the trace's total event count (retries/spins included via a
         generous per-event multiplier) — a 10M constant would abort the
         billion-event runs this engine exists for."""
-        cfg = self.cfg
-        C = cfg.n_cores
-        if max_steps is None:
-            max_steps = max(10_000_000, 64 * int(self.real_len.sum()))
-        budget = max_steps
+        budget = max_steps if max_steps is not None else self._default_budget()
         while True:
-            buf, exhausted, filled = self._fill_window()
-            st = self.state._replace(ptr=jnp.zeros(C, jnp.int32))
-            st, acc_lo, acc_hi, base_lo, base_hi, k = stream_loop(
-                cfg,
-                jnp.asarray(buf),
-                st,
-                jnp.asarray(exhausted),
-                jnp.asarray(filled),
-                jnp.asarray(min(budget, 2**31 - 1), jnp.int32),
-                has_sync=self.has_sync,
-            )
-            # drain: periodic on-device accumulators + the <=63-step residue
-            acc = (
-                (np.asarray(acc_hi).astype(np.int64) << _ACC_BITS)
-                + np.asarray(acc_lo).astype(np.int64)
-                + np.asarray(st.counters).astype(np.int64)
-            )
-            for i, name in enumerate(COUNTER_NAMES):
-                self.host_counters[name] += acc[i]
-            self.cycle_base += (
-                np.int64(np.asarray(base_hi)) << _ACC_BITS
-            ) + np.int64(np.asarray(base_lo))
-            st = st._replace(counters=jnp.zeros_like(st.counters))
-            consumed = np.asarray(st.ptr).astype(np.int64)
-            k_int = int(np.asarray(k))
-            self.steps_run += k_int
-            budget -= k_int
-            self.state = st
-            at_end = (
-                buf[np.arange(C), np.minimum(consumed, self.W), 0] == EV_END
-            )
-            self.cursor += consumed
-            if (at_end & exhausted).all():
+            k, finished = self._advance_window(budget)
+            budget -= k
+            if finished:
                 return
             if budget <= 0:
                 raise RuntimeError(
@@ -182,11 +212,32 @@ class StreamEngine:
                     "events consumed — deadlocked barrier/lock, or pass a "
                     "larger max_steps"
                 )
-            if k_int == 0 and not consumed.any():
-                raise RuntimeError(
-                    "stream engine: no progress in a window (window_events "
-                    "too small for this trace shape?)"
-                )
+
+    def run_events(self, target_events: int) -> bool:
+        """Advance window-by-window until at least `target_events` trace
+        events are consumed in total (or the stream finishes); the natural
+        pause point for a streaming checkpoint. Returns finished."""
+        budget = self._default_budget()
+        while int(self.cursor.sum()) < target_events:
+            k, finished = self._advance_window(budget)
+            budget -= k
+            if finished:
+                return True
+            if budget <= 0:
+                raise RuntimeError("stream engine: step budget exhausted")
+        return False
+
+    # ---- checkpoint / resume (SURVEY.md §5.4, streaming) -----------------
+
+    def save_checkpoint(self, path: str) -> None:
+        from ..sim.checkpoint import save_stream_checkpoint
+
+        save_stream_checkpoint(path, self)
+
+    def load_checkpoint(self, path: str) -> None:
+        from ..sim.checkpoint import load_stream_checkpoint
+
+        load_stream_checkpoint(path, self)
 
     # ---- results (Engine-compatible surface) -----------------------------
 

@@ -55,16 +55,14 @@ def state_pspecs() -> MachineState:
     return MachineState(
         cycles=P(AXIS),
         ptr=P(AXIS),
-        l1_tag=P(AXIS),
-        l1_state=P(AXIS),
-        l1_lru=P(AXIS),
-        l1_ptr=P(AXIS),
-        llc_tag=P(AXIS),
-        llc_owner=P(AXIS),
-        llc_lru=P(AXIS),
+        l1=P(AXIS),
+        llc_meta=P(AXIS),
         sharers=P(AXIS),
-        # lock/barrier tables are small and written from arbitrary cores'
-        # lanes — replicate them (XLA reduces the scatters across devices)
+        # link/lock/barrier tables are small and written from arbitrary
+        # cores' lanes — replicate them (XLA reduces the scatters across
+        # devices)
+        link_free=P(),
+        dram_free=P(AXIS),  # bank-axis, like the LLC it sits beside
         lock_holder=P(),
         barrier_count=P(),
         barrier_time=P(),

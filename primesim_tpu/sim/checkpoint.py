@@ -25,7 +25,7 @@ from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES
 from .state import MachineState
 
-_FORMAT = 1
+_FORMAT = 2  # v2: fused llc_meta replaces llc_tag/llc_owner; 2D llc_lru
 
 
 def trace_fingerprint(trace) -> str:
@@ -63,6 +63,62 @@ def save_checkpoint(path: str, engine) -> None:
     )
 
 
+def save_stream_checkpoint(path: str, eng) -> None:
+    """Snapshot a StreamEngine at a window boundary (its consistent cut):
+    the machine-state pytree plus the per-core stream cursors and 64-bit
+    host accumulators. Valid whenever no device window is in flight —
+    i.e. between `_advance_window` dispatches (`run_events` pauses
+    there)."""
+    st = eng.state
+    arrays = {f"state_{k}": np.asarray(v) for k, v in st._asdict().items()}
+    arrays["host_counters"] = np.stack(
+        [eng.host_counters[k] for k in COUNTER_NAMES]
+    )
+    np.savez_compressed(
+        path,
+        format=np.int64(_FORMAT),
+        stream=np.int64(1),
+        cycle_base=np.int64(eng.cycle_base),
+        steps_run=np.int64(eng.steps_run),
+        cursor=eng.cursor,
+        window_events=np.int64(eng.W),
+        config_json=np.frombuffer(eng.cfg.to_json().encode(), dtype=np.uint8),
+        trace_sha=np.frombuffer(
+            trace_fingerprint(eng.trace).encode(), dtype=np.uint8
+        ),
+        **arrays,
+    )
+
+
+def load_stream_checkpoint(path: str, eng) -> None:
+    """Restore a streaming snapshot into a freshly-built StreamEngine on
+    the same config + trace (fingerprint-validated). Resuming then
+    re-fills the window from the restored cursors — bit-exact with an
+    uninterrupted run (tests/test_checkpoint.py)."""
+    z = np.load(path)
+    if int(z["format"]) != _FORMAT or "stream" not in z:
+        raise ValueError(f"{path}: not a compatible streaming checkpoint")
+    if MachineConfig.from_json(bytes(z["config_json"]).decode()) != eng.cfg:
+        raise ValueError(f"{path}: checkpoint config does not match engine")
+    if bytes(z["trace_sha"]).decode() != trace_fingerprint(eng.trace):
+        raise ValueError(f"{path}: checkpoint trace does not match engine")
+    if int(z["window_events"]) != eng.W:
+        raise ValueError(
+            f"{path}: checkpoint window_events {int(z['window_events'])} "
+            f"!= engine {eng.W} (windows must match for bit-exact resume)"
+        )
+    eng.state = MachineState(
+        **{k: jnp.asarray(z[f"state_{k}"]) for k in MachineState._fields}
+    )
+    eng.cursor = z["cursor"].astype(np.int64)
+    eng.cycle_base = np.int64(z["cycle_base"])
+    eng.steps_run = int(z["steps_run"])
+    hc = z["host_counters"]
+    eng.host_counters = {
+        k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
+    }
+
+
 def load_checkpoint(path: str, engine) -> None:
     """Restore a snapshot into a freshly-constructed Engine.
 
@@ -72,6 +128,10 @@ def load_checkpoint(path: str, engine) -> None:
     z = np.load(path)
     if int(z["format"]) != _FORMAT:
         raise ValueError(f"{path}: unsupported checkpoint format {int(z['format'])}")
+    if "stream" in z:
+        raise ValueError(
+            f"{path}: streaming checkpoint — resume it with a StreamEngine"
+        )
     cfg_json = bytes(z["config_json"]).decode()
     if MachineConfig.from_json(cfg_json) != engine.cfg:
         raise ValueError(f"{path}: checkpoint config does not match engine config")

@@ -54,6 +54,41 @@ _ACC_BITS = 30  # device counter accumulators carry into hi above 2^30
 _CIDX = {k: i for i, k in enumerate(COUNTER_NAMES)}
 
 
+@functools.lru_cache(maxsize=None)
+def _group_tables(cfg: MachineConfig):
+    """Static per-(home tile, sharer group) reduction tables for the
+    coarse vector (sharer_group > 1): member count, max round-trip
+    latency over members, and summed round-trip hops — the group-level
+    stand-ins for the full-map model's per-core [C, C] expansion, sized
+    [n_tiles, n_groups] instead. NumPy at trace time; constants in the
+    compiled graph."""
+    G = cfg.sharer_group
+    C = cfg.n_cores
+    n_grp = cfg.n_sharer_groups
+    nt = cfg.n_tiles
+    mx = cfg.noc.mesh_x
+    ids = np.arange(n_grp)[:, None] * G + np.arange(G)[None, :]  # [n_grp, G]
+    valid = ids < C
+    mt = (ids % nt).astype(np.int64)
+    gx, gy = mt % mx, mt // mx
+    members = valid.sum(1).astype(np.int32)  # [n_grp]
+    max2lat = np.zeros((nt, n_grp), np.int32)
+    sum2hops = np.zeros((nt, n_grp), np.int32)
+    step = max(1, (1 << 24) // (n_grp * G))  # bound temporaries to ~16M
+    for lo in range(0, nt, step):
+        t = np.arange(lo, min(lo + step, nt))
+        tx, ty = (t % mx)[:, None, None], (t // mx)[:, None, None]
+        h = np.abs(tx - gx[None]) + np.abs(ty - gy[None])  # [T, n_grp, G]
+        lat2 = 2 * (h * cfg.noc.link_lat + (h + 1) * cfg.noc.router_lat)
+        max2lat[t] = np.where(valid[None], lat2, 0).max(2).astype(np.int32)
+        sum2hops[t] = (
+            np.where(valid[None], 2 * h, 0).sum(2).astype(np.int32)
+        )
+    # NumPy out (converted at each use site): caching jnp arrays created
+    # inside a trace would leak that trace's tracers into later jits
+    return members, max2lat, sum2hops
+
+
 def _one_way(tile_a, tile_b, cfg: MachineConfig):
     """Vectorized mesh latency + hop count (noc/mesh.py semantics)."""
     mx = cfg.noc.mesh_x
@@ -87,8 +122,7 @@ def _path_links(cfg: MachineConfig, a, b):
     )
 
 
-def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
-              llc_tag, llc_owner, sharers, line):
+def _l1_probe(cfg: MachineConfig, arange_c, l1, llc_meta, sharers, line):
     """Gather the accessed L1 set and derive each way's EFFECTIVE MESI state.
 
     PULL-BASED COHERENCE (the TPU-native shape of MESI): remote
@@ -106,38 +140,74 @@ def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
     golden model + parity tests prove it on every workload.
 
     The directory entry is located through the way pointer (`l1_ptr`,
-    recorded at fill time) — three 1-element gathers — instead of a
-    W2-wide tag search of the home set; a stale pointer self-detects by
-    tag mismatch and yields exactly the search result (DESIGN.md §7).
+    recorded at fill time) — one paired tag/owner gather plus one sharer
+    -word gather — instead of a W2-wide tag search of the home set; a
+    stale pointer self-detects by tag mismatch and yields exactly the
+    search result (DESIGN.md §7).
 
-    The pointer is decomposed into (bank, set, way) coordinates and the
-    gathers index the LLC/sharer arrays in their NATIVE layouts: a
+    The pointer is decomposed into (bank, in-row offset) coordinates and
+    the gathers index the LLC/sharer arrays in their NATIVE layouts: a
     `reshape(-1)` flat view of a TPU-tiled array is a physical relayout —
     XLA materializes a full copy of the (537 MB at 1024 cores) sharers
     array every step, the round-2 perf regression.
 
-    Returns (w1cols, tag_rows, weff): the set's column indices, tags, and
-    effective per-way MESI states, all [C, W1].
+    Returns (w1cols, tag_rows, lru_rows, weff): the set's column indices,
+    tags, LRU stamps, and effective per-way MESI states, all [C, W1].
     """
     S1, W1 = cfg.l1.sets, cfg.l1.ways
+    FS = W1 * S1
+    l1s = line & (S1 - 1)
+    # the fused L1 array holds four planes (tag/state/lru/ptr) at a
+    # FS-column stride; ONE take_along over the concatenated plane
+    # columns fetches the accessed set's whole bookkeeping
+    w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]
+    planes = [w1cols, w1cols + FS, w1cols + 2 * FS, w1cols + 3 * FS]
+    if cfg.sharer_group > 1:
+        planes.append(w1cols + 4 * FS)  # fill-time epoch plane
+    rows = jnp.take_along_axis(
+        l1, jnp.concatenate(planes, axis=1), axis=1
+    )  # [C, 4*W1] or [C, 5*W1]
+    tag_rows = rows[:, :W1]
+    state_rows = rows[:, W1 : 2 * W1]
+    lru_rows = rows[:, 2 * W1 : 3 * W1]
+    ptr_rows = rows[:, 3 * W1 : 4 * W1]
+    eph_rows = rows[:, 4 * W1 :] if cfg.sharer_group > 1 else None
+    weff = _validate_ways(
+        cfg, arange_c, tag_rows, state_rows, ptr_rows, eph_rows, llc_meta,
+        sharers,
+    )
+    return w1cols, tag_rows, lru_rows, weff
+
+
+def _validate_ways(cfg, arange_c, tag_rows, state_rows, ptr_rows, eph_rows,
+                   llc_meta, sharers):
+    """Pull-validate each way's locally-written state against the
+    directory entry its fill-time way pointer names (see `_l1_probe`):
+    two llc_meta element gathers + one sharer-word gather, all [C, W1].
+
+    Under the coarse sharer vector (sharer_group > 1) the core checks
+    its GROUP's bit, which may stay set on a NEIGHBOR's behalf after
+    this core was invalidated — so the group-bit path additionally
+    requires the entry's INVALIDATION EPOCH (bumped by every sharer-
+    clearing transition) to still equal the one this core recorded at
+    fill time. Epoch-match + group-bit is exactly eager-golden validity:
+    every S grant after the last clearing records the current epoch, and
+    anything older was invalidated by that clearing. The owner path
+    needs no epoch (owner identity is exact)."""
     S2, W2 = cfg.llc.sets, cfg.llc.ways
     NW = cfg.n_sharer_words
-    l1s = line & (S1 - 1)
-    # L1 arrays are [C, W1*S1] (column w*S1 + s); pull the accessed set's
-    # per-way columns
-    w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]
-    tag_rows = jnp.take_along_axis(l1_tag, w1cols, axis=1)  # [C, W1]
-    state_rows = jnp.take_along_axis(l1_state, w1cols, axis=1)
-    ptr_rows = jnp.take_along_axis(l1_ptr, w1cols, axis=1)  # [C, W1]
+    logG = cfg.sharer_group.bit_length() - 1
+    g_c = arange_c >> logG
     pway = ptr_rows % W2  # ptr = (bank*S2 + set)*W2 + way
     pslot = ptr_rows // W2
-    pbank = pslot // S2
-    pbset = pslot % S2
-    vtag = llc_tag[pbank, pbset, pway]  # [C, W1]
-    vown = llc_owner[pbank, pbset, pway]
-    vsh = sharers[pslot, pway * NW + (arange_c[:, None] >> 5)]
-    vbit = ((vsh >> (arange_c[:, None] & 31).astype(jnp.uint32)) & 1) != 0
-    weff = jnp.where(
+    vtag = llc_meta[pslot, 2 * pway]  # [C, W1]
+    vown = llc_meta[pslot, 2 * pway + 1]
+    vsh = sharers[pslot, pway * NW + (g_c[:, None] >> 5)]
+    vbit = ((vsh >> (g_c[:, None] & 31).astype(jnp.uint32)) & 1) != 0
+    if cfg.sharer_group > 1:
+        veph = llc_meta[pslot, 3 * W2 + pway]
+        vbit = vbit & (veph == eph_rows)
+    return jnp.where(
         (state_rows == I) | (vtag != tag_rows),
         I,
         jnp.where(
@@ -146,48 +216,6 @@ def _l1_probe(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
             jnp.where(vbit, S, I),
         ),
     )  # [C, W1] effective MESI per way
-    return w1cols, tag_rows, weff
-
-
-def _l1_probe_hit(cfg: MachineConfig, arange_c, l1_tag, l1_state, l1_ptr,
-                  llc_tag, llc_owner, sharers, line):
-    """Hit-only probe: effective MESI state of the (unique) tag-matching way.
-
-    Local runs never fill, so they don't need victim validation; probing
-    only the matching way turns the full probe's three [C, W1] gathers into
-    three [C] gathers. Tags are unique per set (the fill path clears stale
-    duplicates), so the locally-matching way is the only hit candidate, and
-    a way whose local state is I validates to I either way — hit/miss and
-    hit-state agree exactly with `_l1_probe`.
-
-    Returns (hit_any, hit_state, hit_col): effective hit mask, the matching
-    way's effective state, and its flat L1 column (way*S1 + set), all [C].
-    """
-    S1, W1 = cfg.l1.sets, cfg.l1.ways
-    S2, W2 = cfg.llc.sets, cfg.llc.ways
-    NW = cfg.n_sharer_words
-    l1s = line & (S1 - 1)
-    w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]
-    tag_rows = jnp.take_along_axis(l1_tag, w1cols, axis=1)  # [C, W1]
-    state_rows = jnp.take_along_axis(l1_state, w1cols, axis=1)
-    lmatch = (tag_rows == line[:, None]) & (state_rows != I)
-    lhit = jnp.any(lmatch, axis=1)
-    lway = jnp.argmax(lmatch, axis=1).astype(jnp.int32)
-    hit_col = lway * S1 + l1s
-    lstate = state_rows[arange_c, lway]
-    ptr = l1_ptr[arange_c, hit_col]  # [C]
-    pway = ptr % W2
-    pslot = ptr // W2
-    vtag = llc_tag[pslot // S2, pslot % S2, pway]  # [C]
-    vown = llc_owner[pslot // S2, pslot % S2, pway]
-    vsh = sharers[pslot, pway * NW + (arange_c >> 5)]
-    vbit = ((vsh >> (arange_c & 31).astype(jnp.uint32)) & 1) != 0
-    eff = jnp.where(
-        ~lhit | (vtag != line),
-        I,
-        jnp.where(vown == arange_c, lstate, jnp.where(vbit, S, I)),
-    )
-    return eff != I, eff, hit_col
 
 
 def step(
@@ -206,16 +234,42 @@ def step(
     n_tiles = cfg.n_tiles
     arange_c = jnp.arange(C, dtype=jnp.int32)
     cpi_vec = jnp.asarray(cfg.core.cpi_vector(C), jnp.int32)
-    cnt = st.counters
+    # Counter deltas accumulate in a host-side dict of [C] lanes and fold
+    # into the [n_counters, C] array in ONE stacked add at the end of the
+    # step: each `.at[row].add` is its own dynamic-update-slice kernel,
+    # and ~25 of them per step cost real per-kernel overhead (the phase
+    # profile billed ~0.26 ms to a block of ten) while the dict adds fuse
+    # into the surrounding elementwise work for free.
+    _cacc: dict[str, object] = {}
 
     def cadd(cnt, name, amount):
-        return cnt.at[_CIDX[name]].add(amount.astype(jnp.int32))
+        a = amount.astype(jnp.int32)
+        _cacc[name] = a if name not in _cacc else _cacc[name] + a
+        return cnt
+
+    def cflush(cnt):
+        rows = [
+            _cacc[k] if k in _cacc else jnp.zeros(C, jnp.int32)
+            for k in COUNTER_NAMES
+        ]
+        return cnt + jnp.stack(rows)
+
+    cnt = st.counters
 
     # ---- phase 0: quantum barrier (on step-entry state) ------------------
     # Barrier-frozen cores (arrived, waiting for release) neither bump nor
-    # bound the quantum (DESIGN.md §3): they rejoin at release.
-    p0 = jnp.minimum(st.ptr, T - 1)
-    et0 = events[arange_c, p0, 0]
+    # bound the quantum (DESIGN.md §3): they rejoin at release. With local
+    # runs enabled the event at ptr is slot 0 of the phase-0.5 prefetch —
+    # reuse it instead of a separate gather kernel.
+    if cfg.local_run_len:
+        _rl0 = cfg.local_run_len
+        _ioff0 = jnp.arange(_rl0 + 1, dtype=jnp.int32)
+        _pidx0 = jnp.minimum(st.ptr[:, None] + _ioff0[None, :], T - 1)
+        _pev0 = events[arange_c[:, None], _pidx0]  # [C, rl+1, 4]
+        et0 = _pev0[:, 0, 0]
+    else:
+        p0 = jnp.minimum(st.ptr, T - 1)
+        et0 = events[arange_c, p0, 0]
     countable0 = (et0 != EV_END) & ~((et0 == EV_BARRIER) & (st.sync_flag != 0))
     any_countable = jnp.any(countable0)
     any_active = jnp.any(countable0 & (st.cycles < st.quantum_end))
@@ -232,70 +286,200 @@ def step(
     # runs) and the core's own live L1 state. Stops at the first non-local
     # event, the quantum boundary, or the run limit. These are one-hot
     # lane updates on the core's own row only — no cross-core effects.
+    #
+    # PREFETCHED: during a run the pointer advances by exactly one per
+    # retired event, so candidate i sits at ptr0 + i and everything every
+    # iteration's hit probe reads is known up front: the directory
+    # (llc_meta/sharers) is read-only for the whole phase, l1_tag never
+    # changes during a run, and l1_state changes only by deferred silent
+    # E->M writes the probe cannot distinguish (match needs != I, write
+    # hit needs >= E). So the rl+1 candidate events, their L1 set rows,
+    # their home-set metadata, and their self-sharer words come in via
+    # FIVE batched gathers, and the unrolled loop below is pure lane
+    # arithmetic — the per-iteration element-gathers on the multi-hundred
+    # -MB directory arrays (the round-4 local-run wall) are gone.
+    #
+    # The probe validates against the accessed line's HOME entry (W2-wide
+    # tag search of the gathered metadata row) rather than through the L1
+    # way pointer; DESIGN.md §7 proves search- and pointer-validation
+    # observably identical (a stale pointer self-detects to exactly the
+    # search result), and the parity suite re-proves it on every workload.
     cycles_c, ptr_c = st.cycles, st.ptr
-    l1_state_c, l1_lru_c = st.l1_state, st.l1_lru
-    run = jnp.ones(C, bool)
-    # Per-iteration L1 scatters and counter bumps are DEFERRED out of the
-    # unrolled loop (accumulated below, applied once after it): nothing in
-    # the loop reads l1_lru, and the probe treats E and M identically (a
-    # match needs state != I; a write hit needs state >= E), so a deferred
-    # silent E->M is invisible to later iterations — 2*rl scatters + 3*rl
-    # counter updates collapse to 2 + 3. Duplicate (row, col) pairs across
-    # iterations write identical values (step_no / M), so the merged
-    # scatter is order-independent.
-    rhit_acc = jnp.zeros(C, jnp.int32)
-    whit_acc = jnp.zeros(C, jnp.int32)
-    ins_acc = jnp.zeros(C, jnp.int32)
-    hit_masks, whit_masks, hit_cols = [], [], []
-    for _ in range(cfg.local_run_len):
-        pr = jnp.minimum(ptr_c, T - 1)
-        evr = events[arange_c, pr]  # [C, 4]
-        etr, eargr, eaddrr, eprer = evr[:, 0], evr[:, 1], evr[:, 2], evr[:, 3]
-        can = run & (etr != EV_END) & (cycles_c < quantum_end)
-        is_ins_r = can & (etr == EV_INS)
-        line_r = eaddrr  # ingest is line-granular (Trace.line_events)
-        hit_any_r, hit_state_r, hit_col_r = _l1_probe_hit(
-            cfg, arange_c, st.l1_tag, l1_state_c, st.l1_ptr, st.llc_tag,
-            st.llc_owner, st.sharers, line_r,
+    l1_c = st.l1
+    FS = W1 * S1  # plane stride in the fused L1 array
+    rl = cfg.local_run_len
+    logB = B.bit_length() - 1
+    if rl:
+        pev = _pev0  # [C, rl+1, 4] — gathered once in phase 0
+        pline = pev[:, :, 2]  # line-granular (Trace.line_events)
+        ps = pline & (S1 - 1)
+        pcols = (
+            jnp.arange(W1, dtype=jnp.int32)[None, None, :] * S1
+            + ps[:, :, None]
+        )  # [C, rl+1, W1]
+        pcf = pcols.reshape(C, (rl + 1) * W1)
+        # tag + state planes of every candidate's set in ONE take_along
+        # (lru/ptr aren't needed for run hit probes; feeding them to the
+        # arbitration probe too was tried and measured SLOWER — the extra
+        # select/patch kernels outweighed the saved gathers). The coarse
+        # vector additionally needs the fill-time epoch plane.
+        KW = (rl + 1) * W1
+        pl_cols = [pcf, pcf + FS]
+        if cfg.sharer_group > 1:
+            pl_cols.append(pcf + 4 * FS)
+        pts = jnp.take_along_axis(
+            st.l1, jnp.concatenate(pl_cols, axis=1), axis=1
         )
-        is_st_r = etr == EV_ST
-        r_hit = can & (etr == EV_LD) & hit_any_r
-        w_hit = can & is_st_r & hit_any_r & (hit_state_r >= E)
-        hit_r = r_hit | w_hit
-        local = is_ins_r | hit_r
-        cycles_c = cycles_c + jnp.where(
-            is_ins_r,
-            eargr * cpi_vec,
-            jnp.where(hit_r, eprer * cpi_vec + cfg.l1.latency, 0),
+        ptagr = pts[:, :KW].reshape(C, rl + 1, W1)
+        pstater = pts[:, KW : 2 * KW].reshape(C, rl + 1, W1)
+        pbank = pline & (B - 1)
+        pbset = (pline >> logB) & (S2 - 1)
+        pslot = pbank * S2 + pbset
+        pmrows = st.llc_meta[pslot]  # [C, rl+1, MW]
+        pmeta = pmrows[:, :, : 2 * W2].reshape(C, rl + 1, W2, 2)
+        pmmatch = pmeta[..., 0] == pline[:, :, None]
+        pmhas = jnp.any(pmmatch, axis=2)
+        pmway = jnp.argmax(pmmatch, axis=2).astype(jnp.int32)
+        pown = jnp.take_along_axis(pmeta[..., 1], pmway[:, :, None], axis=2)[
+            :, :, 0
+        ]
+        g_c0 = arange_c >> (cfg.sharer_group.bit_length() - 1)
+        pshw = st.sharers[pslot, pmway * NW + (g_c0[:, None] >> 5)]
+        pbit = ((pshw >> (g_c0[:, None] & 31).astype(jnp.uint32)) & 1) != 0
+        pmatch_l = (ptagr == pline[:, :, None]) & (pstater != I)
+        plhit = jnp.any(pmatch_l, axis=2)
+        plway = jnp.argmax(pmatch_l, axis=2).astype(jnp.int32)
+        plstate = jnp.take_along_axis(pstater, plway[:, :, None], axis=2)[
+            :, :, 0
+        ]
+        if cfg.sharer_group > 1:
+            # epoch guard (see _validate_ways): the group bit only keeps
+            # this core's S line alive if no sharer-clearing transition
+            # happened since its fill
+            pleph = jnp.take_along_axis(
+                pts[:, 2 * KW :].reshape(C, rl + 1, W1),
+                plway[:, :, None],
+                axis=2,
+            )[:, :, 0]
+            pveph = jnp.take_along_axis(
+                pmrows[:, :, 3 * W2 : 4 * W2], pmway[:, :, None], axis=2
+            )[:, :, 0]
+            pbit = pbit & (pveph == pleph)
+        peff = jnp.where(
+            ~(plhit & pmhas),
+            I,
+            jnp.where(
+                pown == arange_c[:, None],
+                plstate,
+                jnp.where(pbit, S, I),
+            ),
+        )  # [C, rl+1] effective MESI of the tag-matching way
+        phitcol = plway * S1 + ps
+    if rl:
+        # CLOSED FORM for the run itself (no unrolled loop): a candidate
+        # retires iff every earlier candidate was local (prefix-AND via
+        # cumprod) and the clock BEFORE it — an exclusive prefix sum of
+        # retired costs — is still inside the quantum. The serial
+        # recurrence and this form agree exactly: costs are
+        # non-negative, so the clock-before sequence is non-decreasing
+        # and the first quantum crossing cuts both the same way; a
+        # pref-but-quantum-stopped candidate forces every later
+        # clock-before past the boundary, so over-counting its cost in
+        # the prefix sum can never resurrect a later candidate. L1
+        # scatters and counter bumps are single fused ops over the
+        # [C, rl] retire masks (nothing in the run reads l1_lru, and the
+        # probe treats E and M identically, so the deferred silent E->M
+        # is invisible — DESIGN.md §3).
+        etr = pev[:, :rl, 0]
+        eargr = pev[:, :rl, 1]
+        eprer = pev[:, :rl, 3]
+        is_ins_k = etr == EV_INS
+        r_hit_k = (etr == EV_LD) & (peff[:, :rl] != I)
+        w_hit_k = (etr == EV_ST) & (peff[:, :rl] >= E)
+        hit_k = r_hit_k | w_hit_k
+        local_k = is_ins_k | hit_k  # END/sync/miss candidates stop the run
+        pref = jnp.cumprod(local_k.astype(jnp.int32), axis=1) != 0
+        cost_k = jnp.where(
+            is_ins_k,
+            eargr * cpi_vec[:, None],
+            eprer * cpi_vec[:, None] + cfg.l1.latency,
         )
-        ptr_c = ptr_c + local.astype(jnp.int32)
-        rhit_acc = rhit_acc + r_hit
-        whit_acc = whit_acc + w_hit
-        ins_acc = ins_acc + (
-            jnp.where(is_ins_r, eargr, 0) + jnp.where(hit_r, eprer + 1, 0)
+        cost_p = jnp.where(pref, cost_k, 0)
+        clock_before = (
+            cycles_c[:, None] + jnp.cumsum(cost_p, axis=1) - cost_p
         )
-        hit_masks.append(hit_r)
-        whit_masks.append(w_hit)
-        hit_cols.append(hit_col_r)
-        run = local  # stop at the first non-local event
-    if cfg.local_run_len:
-        cnt = cadd(cnt, "l1_read_hits", rhit_acc)
-        cnt = cadd(cnt, "l1_write_hits", whit_acc)
-        cnt = cadd(cnt, "instructions", ins_acc)
-        hm = jnp.stack(hit_masks, axis=1)  # [C, rl]
-        wm = jnp.stack(whit_masks, axis=1)
-        cm = jnp.stack(hit_cols, axis=1)
-        l1_lru_c = l1_lru_c.at[
-            jnp.where(hm, arange_c[:, None], C), cm
-        ].set(step_no, mode="drop")
-        l1_state_c = l1_state_c.at[
-            jnp.where(wm, arange_c[:, None], C), cm
-        ].set(M, mode="drop")
+        retire_k = pref & (clock_before < quantum_end)
+        cycles_c = cycles_c + jnp.sum(
+            jnp.where(retire_k, cost_k, 0), axis=1
+        )
+        ptr_c = ptr_c + jnp.sum(retire_k, axis=1).astype(jnp.int32)
+        cnt = cadd(cnt, "l1_read_hits", jnp.sum(r_hit_k & retire_k, axis=1))
+        cnt = cadd(cnt, "l1_write_hits", jnp.sum(w_hit_k & retire_k, axis=1))
+        cnt = cadd(
+            cnt,
+            "instructions",
+            jnp.sum(
+                jnp.where(
+                    retire_k,
+                    jnp.where(is_ins_k, eargr, eprer + 1),
+                    0,
+                ),
+                axis=1,
+            ),
+        )
+        hm = hit_k & retire_k  # [C, rl]
+        wm = w_hit_k & retire_k
+        cm = phitcol[:, :rl]
+        # one scatter covers both deferred planes: LRU refreshes at
+        # plane 2, silent E->M at plane 1 (distinct planes, so no
+        # duplicate targets even when the same way takes both)
+        l1_c = l1_c.at[
+            jnp.concatenate(
+                [
+                    jnp.where(hm, arange_c[:, None], C),
+                    jnp.where(wm, arange_c[:, None], C),
+                ],
+                axis=1,
+            ),
+            jnp.concatenate([cm + 2 * FS, cm + FS], axis=1),
+        ].set(
+            jnp.concatenate(
+                [
+                    jnp.broadcast_to(step_no, (C, rl)),
+                    jnp.full((C, rl), M, jnp.int32),
+                ],
+                axis=1,
+            ),
+            mode="drop",
+        )
 
-    # ---- phase 0.9: gather the arbitration-phase events ------------------
-    p = jnp.minimum(ptr_c, T - 1)
-    ev = events[arange_c, p]  # [C, 4]
+    # ---- phase 0.9 + phase 1: the arbitration event and its L1 probe -----
+    # addresses arrive LINE-granular (Trace.line_events normalizes byte
+    # traces at ingest; v4 line-addressed traces pass through) — 2^31
+    # lines = 128 GiB at 64B lines, 64x the byte-addressed range
+    if rl:
+        # a lane that retired k local events arbitrates candidate k
+        # (clamped pidx repeats the final END row, so over-running lanes
+        # read END here exactly as a direct gather would). Reusing MORE
+        # of the prefetch here (classification, L1 planes, home metadata
+        # row) was tried and measured slower: the select/patch kernels
+        # cost more than the gathers they replaced.
+        consumed = (ptr_c - st.ptr)[:, None, None]
+        ev = jnp.take_along_axis(pev, consumed, axis=1)[:, 0]  # [C, 4]
+    else:
+        p = jnp.minimum(ptr_c, T - 1)
+        ev = events[arange_c, p]  # [C, 4]
     et, earg, eaddr, epre = ev[:, 0], ev[:, 1], ev[:, 2], ev[:, 3]
+    line = eaddr
+    l1s = line & (S1 - 1)
+    w1cols, tag_rows, lru_rows, weff = _l1_probe(
+        cfg, arange_c, l1_c, st.llc_meta, st.sharers, line,
+    )
+    l1_match = (tag_rows == line[:, None]) & (weff != I)
+    hit_any = jnp.any(l1_match, axis=1)
+    hit_way = jnp.argmax(l1_match, axis=1).astype(jnp.int32)
+    hit_state = weff[arange_c, hit_way]
+
     not_done = et != EV_END
     frozen = (et == EV_BARRIER) & (st.sync_flag != 0)
     active = not_done & ~frozen & (cycles_c < quantum_end)
@@ -307,22 +491,6 @@ def step(
     is_unlock = active & (et == EV_UNLOCK)
     is_barrier = active & (et == EV_BARRIER)  # arrivals (frozen excluded)
 
-    # ---- phase 1: L1 lookup + classification (post-run state) ------------
-    # addresses arrive LINE-granular (Trace.line_events normalizes byte
-    # traces at ingest; v4 line-addressed traces pass through) — 2^31
-    # lines = 128 GiB at 64B lines, 64x the byte-addressed range
-    line = eaddr  # [C] int32 line index
-    l1s = line & (S1 - 1)
-    w1cols, tag_rows, weff = _l1_probe(
-        cfg, arange_c, st.l1_tag, l1_state_c, st.l1_ptr, st.llc_tag,
-        st.llc_owner, st.sharers, line,
-    )
-
-    l1_match = (tag_rows == line[:, None]) & (weff != I)
-    hit_any = jnp.any(l1_match, axis=1)
-    hit_way = jnp.argmax(l1_match, axis=1).astype(jnp.int32)
-    hit_state = weff[arange_c, hit_way]
-
     read_hit = is_mem & ~is_st_ev & hit_any
     write_hit = is_mem & is_st_ev & hit_any & (hit_state >= E)
     upg = is_mem & is_st_ev & hit_any & (hit_state == S)
@@ -330,35 +498,53 @@ def step(
     getm = is_mem & is_st_ev & ~hit_any
 
     # LLC lookup for the accessed line (step-start, all lanes — needed both
-    # for join eligibility below and the winner transitions in phase 3)
+    # for join eligibility below and the winner transitions in phase 3).
+    # ONE full-row gather returns the home set's tags, owners AND LRU
+    # stamps; the owner, victim-owner and victim-LRU reads below become
+    # in-register row indexing instead of separate element gathers.
     bank = line & (B - 1)
-    bset = (line >> (B.bit_length() - 1)) & (S2 - 1)
+    bset = (line >> logB) & (S2 - 1)
     slot = bank * S2 + bset  # [C], exact (bank,set) id
-    llc_tag_rows = st.llc_tag[bank, bset]  # [C, W2]
+    meta_rows = st.llc_meta[slot]  # [C, MW]
+    mr2 = meta_rows[:, : 2 * W2].reshape(C, W2, 2)
+    llc_tag_rows = mr2[..., 0]  # [C, W2]
+    owner_rows = mr2[..., 1]
     llc_match = llc_tag_rows == line[:, None]
     llc_has = jnp.any(llc_match, axis=1)
     llc_hway = jnp.argmax(llc_match, axis=1).astype(jnp.int32)
-    owner = st.llc_owner[bank, bset, llc_hway]  # [C]
+    owner = owner_rows[arange_c, llc_hway]  # [C]
     # one contiguous row gather serves hit way, victim way, and join path
     sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]
     shw = jnp.take_along_axis(sh_rows, llc_hway[:, None, None], axis=1)[:, 0]
 
     # sharer-set predicates from the PACKED words — popcount minus the
     # self bit needs no [C, C] expansion (the expansion, when needed for
-    # invalidation targets, happens in phase 3: dense or chunked per
-    # cfg.sharer_chunk_words)
-    word_idx = arange_c // 32  # [C] target -> word
-    bit_idx = (arange_c % 32).astype(jnp.uint32)
+    # invalidation targets, happens in phase 3: dense, chunked, or — for
+    # the coarse vector — group-table reductions). Bit index = the core's
+    # GROUP under cfg.sharer_group (identity at G=1).
+    logG = cfg.sharer_group.bit_length() - 1
+    g_c = arange_c >> logG
+    word_idx = g_c // 32  # [C] self -> sharer word
+    bit_idx = (g_c % 32).astype(jnp.uint32)
 
-    def unpack_bits(words):  # [C, NW] uint32 -> [C, C] bool (first C targets)
+    def unpack_bits(words):  # [C, NW] uint32 -> [C, C] bool per TARGET core
         b = (words[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]) & 1
-        return b.reshape(C, NW * 32)[:, :C] != 0
+        groups = b.reshape(C, NW * 32) != 0
+        # target core t is recorded iff its GROUP's bit is set (identity
+        # expansion at G=1)
+        return jnp.take(groups, g_c, axis=1)
 
     self_bit = ((shw[arange_c, word_idx] >> bit_idx) & 1).astype(jnp.int32)
     total_sharers = jnp.sum(
         jax.lax.population_count(shw), axis=1
     ).astype(jnp.int32)
-    other_sharers = (total_sharers - self_bit) > 0
+    if cfg.sharer_group > 1:
+        # coarse: the requester's own group bit may cover OTHER cores, so
+        # exclusivity (E grants) requires an empty vector (golden
+        # `shared_any`)
+        other_sharers = total_sharers > 0
+    else:
+        other_sharers = (total_sharers - self_bit) > 0
 
     # ---- phase 2: read-join coalescing + per-(bank,set) arbitration ------
     # GETS to an LLC-resident, ownerless, already-shared line may coalesce:
@@ -366,8 +552,12 @@ def step(
     # latency independent of the sharer set and commutative state updates,
     # so any number retire in one step, bit-exact to any serialization
     # order (DESIGN.md §3). A join only proceeds if no arbitrating request
-    # targets its home (bank,set) this step; else it demotes to normal GETS.
+    # targets its home (bank,set) this step; else it demotes to normal
+    # GETS. Disabled under the coarse vector: same-group joiners' bit
+    # updates would collide in the fused scatter-add.
     join_elig = gets & llc_has & (owner == -1) & other_sharers
+    if cfg.sharer_group > 1:
+        join_elig = jnp.zeros_like(join_elig)
     req = (gets & ~join_elig) | getm | upg
     # Packed single-scatter key ordering by (cycles, core_id). Valid because
     # every arbitrating lane's clock lies in [quantum_end - Q, quantum_end):
@@ -408,12 +598,15 @@ def step(
     # charge contention_lat * (count - 1). Link model: each transaction's
     # XY request+reply path (barrier arrivals: one way) claims its links;
     # charge contention_lat * bottleneck (count - 1) over the path —
-    # mirroring golden's _bump/_contention_extra exactly.
-    if cfg.noc.contention:
+    # mirroring golden's _bump/_contention_extra exactly. The "router"
+    # model replaces the analytic request/reply legs wholesale and is
+    # computed after the service components are known (below).
+    router = cfg.noc.contention and cfg.noc.contention_model == "router"
+    home_txn = winner | join
+    if has_sync:
+        home_txn = home_txn | is_lock | is_unlock
+    if cfg.noc.contention and not router:
         ccl = cfg.noc.contention_lat
-        home_txn = winner | join
-        if has_sync:
-            home_txn = home_txn | is_lock | is_unlock
         if cfg.noc.contention_model == "link":
             from ..noc.mesh import n_links
 
@@ -477,11 +670,11 @@ def step(
 
     # --- LLC miss: victim + back-invalidation
     llc_state_valid = llc_tag_rows != -1
-    llc_lru_rows = st.llc_lru[bank, bset]
+    llc_lru_rows = meta_rows[:, 2 * W2 : 3 * W2]  # [C, W2], from the row gather
     vkey = jnp.where(llc_state_valid, llc_lru_rows, -1)
     llc_vway = jnp.argmin(vkey, axis=1).astype(jnp.int32)
     vic_tag = llc_tag_rows[arange_c, llc_vway]
-    vic_owner = st.llc_owner[bank, bset, llc_vway]
+    vic_owner = owner_rows[arange_c, llc_vway]
     vic_shw = jnp.take_along_axis(sh_rows, llc_vway[:, None, None], axis=1)[:, 0]
     vic_valid = llc_miss & (vic_tag != -1)
 
@@ -489,12 +682,78 @@ def step(
     # from the packed sharer words (write invalidations to the accessed
     # line's sharers excluding self; back-invalidations to the victim's
     # sharers PLUS its owner — golden adds the owner to vtargets when not
-    # already recorded). The reduction is either the dense [C, C]
-    # expansion (fastest at <= 1024 cores) or a lax.scan over K-word
-    # blocks bounding temporaries to [C, 32K] (cfg.sharer_chunk_words;
-    # BASELINE rungs 4-5). Bit-exact either way.
+    # already recorded). The reduction is the dense [C, C] expansion
+    # (fastest at <= 1024 cores), a lax.scan over K-word blocks bounding
+    # temporaries to [C, 32K] (cfg.sharer_chunk_words; BASELINE rung 4),
+    # or — under the coarse vector — per-GROUP table reductions sized
+    # [C, n_groups] with NO per-core expansion at all (BASELINE rung 5:
+    # 16384 cores x 256 groups). Each is bit-exact vs the golden model
+    # under the same config.
     inv_row = write_w & llc_hit
-    if cfg.sharer_chunk_words:
+    if cfg.sharer_group > 1:
+        n_grp = cfg.n_sharer_groups
+        memb_n, max2lat_n, sum2hops_n = _group_tables(cfg)
+        memb = jnp.asarray(memb_n)
+        max2lat = jnp.asarray(max2lat_n)
+        sum2hops = jnp.asarray(sum2hops_n)
+        bit5 = jnp.arange(32, dtype=jnp.uint32)
+
+        def _group_bools(words):  # [C, NW] -> [C, n_grp]
+            b = (words[:, :, None] >> bit5[None, None, :]) & 1
+            return b.reshape(C, NW * 32)[:, :n_grp] != 0
+
+        grp = _group_bools(shw)
+        vic_grp = _group_bools(vic_shw)
+        ml_rows = max2lat[btile]  # [C, n_grp]
+        sumh_rows = sum2hops[btile]
+        selfg = jnp.arange(n_grp, dtype=jnp.int32)[None, :] == g_c[:, None]
+        self_rec = jnp.any(grp & selfg, axis=1)  # requester's group flagged
+        # serialization latency spans every recorded core of flagged
+        # groups INCLUDING the requester's slot (golden: the home node
+        # serializes the whole group broadcast); messages/counters skip
+        # the requester
+        inv_lat = jnp.where(
+            inv_row,
+            jnp.max(jnp.where(grp, ml_rows, 0), axis=1),
+            0,
+        )
+        inv_count = jnp.where(
+            inv_row,
+            jnp.sum(jnp.where(grp, memb[None, :], 0), axis=1)
+            - self_rec.astype(jnp.int32),
+            0,
+        )
+        _, self_hops = _one_way(btile, ctile, cfg)
+        inv_hops = jnp.where(
+            inv_row,
+            jnp.sum(jnp.where(grp, sumh_rows, 0), axis=1)
+            - jnp.where(self_rec, 2 * self_hops, 0),
+            0,
+        )
+        # back-invalidation: every recorded core of the victim's flagged
+        # groups, plus its owner when not already recorded
+        og = jnp.maximum(vic_owner, 0) >> logG
+        own_rec = (
+            jnp.take_along_axis(vic_grp, og[:, None], axis=1)[:, 0]
+            & (vic_owner >= 0)
+        )
+        own_extra = (vic_owner >= 0) & ~own_rec
+        _, own_hops = _one_way(
+            btile, jnp.maximum(vic_owner, 0) % n_tiles, cfg
+        )
+        back_count = jnp.where(
+            vic_valid,
+            jnp.sum(jnp.where(vic_grp, memb[None, :], 0), axis=1)
+            + own_extra.astype(jnp.int32),
+            0,
+        )
+        back_hops = jnp.where(
+            vic_valid,
+            jnp.sum(jnp.where(vic_grp, sumh_rows, 0), axis=1)
+            + jnp.where(own_extra, 2 * own_hops, 0),
+            0,
+        )
+    elif cfg.sharer_chunk_words:
         K = cfg.sharer_chunk_words
         nblk = NW // K
         bit5 = jnp.arange(32, dtype=jnp.uint32)
@@ -555,20 +814,181 @@ def step(
         back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)
         back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
 
+    # --- memory-controller queue (cfg.dram_queue, SURVEY §2 #7) -----------
+    # Miss winners queue at their home bank's controller: wait floor =
+    # max(dram_free[bank], bank's earliest nominal arrival this step) +
+    # rank*service — the router model's FIFO shape on a per-bank clock.
+    # Ranks via the same int8 one-hot matmul; bit-exact vs golden
+    # (tests/test_dram.py).
+    if cfg.dram_queue:
+        svc_d = jnp.int32(cfg.dram_service or cfg.dram_lat)
+        a_nom = (
+            cycles_c + epre * cpi_vec + cfg.l1.latency + req_lat
+            + cfg.llc.latency
+        )
+        dtgt = jnp.where(llc_miss, bank, B)
+        dbase = jnp.full(B, INT32_MAX, jnp.int32).at[dtgt].min(
+            a_nom, mode="drop"
+        )
+        kd = ((key[None, :] < key[:, None]) & llc_miss[None, :]).astype(
+            jnp.int8
+        )
+        Ud = jnp.zeros((C, B), jnp.int8).at[arange_c, dtgt].set(
+            1, mode="drop"
+        )
+        rd = jnp.take_along_axis(
+            jax.lax.dot_general(
+                kd, Ud, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ),
+            bank[:, None],
+            axis=1,
+        )[:, 0]
+        dstart = jnp.maximum(
+            a_nom,
+            jnp.maximum(st.dram_free[bank], dbase[bank]) + rd * svc_d,
+        )
+        extra_dram = jnp.where(llc_miss, dstart - a_nom, 0)
+        dram_free_n = st.dram_free.at[dtgt].max(dstart + svc_d, mode="drop")
+        cnt = cadd(cnt, "dram_queue_cycles", extra_dram)
+    else:
+        extra_dram = jnp.zeros(C, jnp.int32)
+        dram_free_n = st.dram_free
+
     # --- latency composition (golden order)
     probe_any = gets_probe | write_probe
-    lat = cfg.l1.latency + req_lat + cfg.llc.latency
-    lat = lat + jnp.where(probe_any, 2 * po_lat, 0)
-    lat = lat + jnp.where(write_w & llc_hit, inv_lat, 0)
-    lat = lat + jnp.where(llc_miss, cfg.dram_lat, 0)
-    lat = lat + rep_lat + extra_home
+    # service interval between the request's arrival at the home bank and
+    # the reply's injection: LLC lookup + probe legs + invalidation waits
+    # + controller queueing + DRAM (memory lanes), plain LLC lookup
+    # (joins, lock/unlock RMWs)
+    service = jnp.where(
+        winner,
+        cfg.llc.latency
+        + jnp.where(probe_any, 2 * po_lat, 0)
+        + jnp.where(write_w & llc_hit, inv_lat, 0)
+        + jnp.where(llc_miss, cfg.dram_lat, 0)
+        + extra_dram,
+        cfg.llc.latency,
+    )
+    link_free_n = st.link_free
+    if router:
+        # ---- hop-by-hop router (golden _route/_route_rt, vectorized) ----
+        # Model: every directed link keeps a next-free clock carried
+        # across steps; a packet waits at link l for
+        #   max(link_free[l], base[l]) + rank_l * link_lat
+        # (base = the link's earliest NOMINAL same-step arrival, rank =
+        # packets on l with smaller (clock, core) key — FIFO
+        # serialization at link_lat per packet), then occupies the link
+        # for link_lat and pays router_lat at the next router; waits
+        # cascade into later hops. The cascade has a closed form: with
+        # F_k the wait floor at hop k and c = link_lat + router_lat,
+        #   t_k = max(t0 + router_lat, cummax_{k'<=k}(F_k' - k'c)) + kc
+        # so one cummax per path replaces the sequential walk, and the
+        # per-link departures feed one scatter-max into link_free. Ranks
+        # come from an int8 one-hot matmul on the MXU (exact int32
+        # counts). Bit-exact vs the golden scalar walk (tests/
+        # test_router.py).
+        from ..noc.mesh import n_links
+
+        NL = n_links(cfg)
+        L_lat = jnp.int32(cfg.noc.link_lat)
+        R_lat = jnp.int32(cfg.noc.router_lat)
+        c_hop = jnp.int32(cfg.noc.link_lat + cfg.noc.router_lat)
+        SENT = jnp.int32(-(1 << 30) - (1 << 21))  # < any real wait floor
+        req_p = _path_links(cfg, ctile, btile)  # [C, H]
+        rep_p = _path_links(cfg, btile, ctile)
+        arr_p = _path_links(cfg, ctile, htile)
+        H = req_p.shape[1]
+        hidx = jnp.arange(H, dtype=jnp.int32)[None, :]
+        first_lock = is_lock & (st.sync_flag == 0)
+        mem_lane = winner | join
+        pre_chg = mem_lane | is_unlock | first_lock | is_barrier
+        t0 = (
+            cycles_c
+            + jnp.where(pre_chg, epre * cpi_vec, 0)
+            + jnp.where(mem_lane, cfg.l1.latency, 0)
+        )
+        # canonical same-step order: the phase-2 arbitration key
+        txn = home_txn | is_barrier
+        kless = (
+            (key[None, :] < key[:, None]) & txn[None, :]
+        ).astype(jnp.int8)
+        U = jnp.zeros((C, NL), jnp.int8)
+        # nominal (uncontended) arrival at each hop; reply legs anchor
+        # at llc.latency service by definition (golden _bump)
+        a_req = t0[:, None] + R_lat + hidx * c_hop
+        a_rep = (
+            t0[:, None]
+            + R_lat
+            + req_hops[:, None] * c_hop
+            + cfg.llc.latency
+            + R_lat
+            + hidx * c_hop
+        )
+        base = jnp.full(NL, INT32_MAX, jnp.int32)
+        for pth, mask, a in (
+            (req_p, home_txn, a_req),
+            (rep_p, home_txn, a_rep),
+        ) + (((arr_p, is_barrier, a_req),) if has_sync else ()):
+            ok = mask[:, None] & (pth >= 0)
+            tgt = jnp.where(ok, pth, NL)
+            U = U.at[arange_c[:, None], tgt].set(1, mode="drop")
+            base = base.at[tgt].min(a, mode="drop")
+        ranks = jax.lax.dot_general(
+            kless, U, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [C, NL]: packets ahead of lane i in l's same-step FIFO
+
+        def _cascade(t_start, pth, mask, nh):
+            ok = mask[:, None] & (pth >= 0)
+            pc = jnp.where(pth >= 0, pth, 0)
+            r = jnp.take_along_axis(ranks, pc, axis=1)
+            F = jnp.maximum(st.link_free[pc], base[pc]) + r * L_lat
+            G = jnp.where(ok, F, SENT) - hidx * c_hop
+            cum = jax.lax.cummax(G, axis=1)
+            t1 = t_start + R_lat
+            t_end = jnp.maximum(t1, cum[:, -1]) + nh * c_hop
+            departs = jnp.maximum(t1[:, None], cum) + hidx * c_hop + L_lat
+            return t_end, departs, ok
+
+        arr_lat_a, arr_hops = _one_way(ctile, htile, cfg)
+        t_req_end, d_req, ok_req = _cascade(t0, req_p, home_txn, req_hops)
+        t_rep_end, d_rep, ok_rep = _cascade(
+            t_req_end + service, rep_p, home_txn, rep_hops
+        )
+        raw_rt = t_rep_end - t0  # valid on home_txn lanes
+        extra_home = raw_rt - (req_lat + service + rep_lat)
+        if has_sync:
+            t_arr_end, d_arr, ok_arr = _cascade(t0, arr_p, is_barrier, arr_hops)
+            raw_arr = t_arr_end - t0  # valid on barrier lanes
+            extra_bar = raw_arr - arr_lat_a
+            dep_all = jnp.concatenate([d_req, d_rep, d_arr], axis=1)
+            ok_all = jnp.concatenate([ok_req, ok_rep, ok_arr], axis=1)
+            pth_all = jnp.concatenate([req_p, rep_p, arr_p], axis=1)
+        else:
+            dep_all = jnp.concatenate([d_req, d_rep], axis=1)
+            ok_all = jnp.concatenate([ok_req, ok_rep], axis=1)
+            pth_all = jnp.concatenate([req_p, rep_p], axis=1)
+        link_free_n = st.link_free.at[
+            jnp.where(ok_all, pth_all, NL)
+        ].max(dep_all, mode="drop")
+        cnt = cadd(
+            cnt,
+            "noc_contention_cycles",
+            jnp.where(home_txn, extra_home, 0)
+            + (jnp.where(is_barrier, extra_bar, 0) if has_sync else 0),
+        )
+        lat = cfg.l1.latency + raw_rt  # memory lanes (service included)
+        lat_join = lat
+    else:
+        lat = cfg.l1.latency + req_lat + service + rep_lat + extra_home
+        # join path: same shape — service is llc.latency on join lanes
+        lat_join = (
+            cfg.l1.latency + req_lat + cfg.llc.latency + rep_lat + extra_home
+        )
     ov = cfg.core.o3_overlap_256
     if ov:
         lat = lat - ((lat * ov) >> 8)
-
-    # join path latency: plain uncore round trip, no probe/inv/DRAM extras
-    lat_join = cfg.l1.latency + req_lat + cfg.llc.latency + rep_lat + extra_home
-    if ov:
         lat_join = lat_join - ((lat_join * ov) >> 8)
 
     # --- granted L1 state (joins always take S)
@@ -643,8 +1063,7 @@ def step(
     # invalid-first rule; the victim writeback fires only on EFFECTIVE M.
     upg_in_place = upg & winner  # upg requires an L1 hit: always in-place
     fill = (winner & ~upg_in_place) | join
-    lru_rows = jnp.take_along_axis(l1_lru_c, w1cols, axis=1)  # [C, W1]
-    l1_vkey = jnp.where(weff == I, -1, lru_rows)
+    l1_vkey = jnp.where(weff == I, -1, lru_rows)  # lru_rows from the probe
     l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
     cnt = cadd(cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M))
     upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
@@ -660,40 +1079,95 @@ def step(
     dup = fill & jnp.any(tagm, axis=1) & (t_way != upd_way)
     dup_row = jnp.where(dup, arange_c, C)
     dup_col = t_way * S1 + l1s
-    l1_tag = st.l1_tag.at[dup_row, dup_col].set(-1, mode="drop")
-    l1_state = l1_state_c.at[dup_row, dup_col].set(I, mode="drop")
 
-    # hit refresh + winner/join fill in one scatter per array (a core
-    # retires as a hit OR a winner/join, never both, so rows are disjoint)
     wj = winner | join
     lru_row = jnp.where(hit | wj, arange_c, C)
     lru_col = jnp.where(hit, hit_col, upd_col)
-    l1_lru = l1_lru_c.at[lru_row, lru_col].set(step_no, mode="drop")
     st_row = jnp.where(write_hit | wj, arange_c, C)  # silent E->M + grants
     st_col = jnp.where(write_hit, hit_col, upd_col)
     st_val = jnp.where(write_hit, M, grant)
-    l1_state = l1_state.at[st_row, st_col].set(st_val, mode="drop")
     wj_row = jnp.where(wj, arange_c, C)
-    l1_tag = l1_tag.at[wj_row, upd_col].set(line, mode="drop")
-    # record the filled line's directory entry position (way pointer);
-    # joins and LLC hits fill at the line's hit way, misses at the victim
+    # the filled line's directory entry position (way pointer); joins and
+    # LLC hits fill at the line's hit way, misses at the victim
     fill_ptr = slot * W2 + jnp.where(join | llc_hit, llc_hway, llc_vway)
-    l1_ptr = st.l1_ptr.at[wj_row, upd_col].set(fill_ptr, mode="drop")
-
-    # LLC entry update: scatter the C winners' rows (collision-free: one
-    # winner per (bank,set)) — scattering C updates beats gathering for all
-    # B*S2 slots on TPU
+    # invalidation epoch: every sharer-CLEARING transition (M grants,
+    # exclusive grants, fills — exactly the owner-taking ones) bumps the
+    # entry's epoch so coarse-vector validation can reject pre-clearing
+    # fill records (GETS probe/shared grants preserve sharers: no bump);
+    # fills record the POST-bump value
     llc_uway = jnp.where(llc_hit, llc_hway, llc_vway)
-    new_owner = jnp.where(write_w | gets_excl_hit | llc_miss, arange_c, -1)
-    wbank = jnp.where(winner, bank, B)
-    llc_tag_n = st.llc_tag.at[wbank, bset, llc_uway].set(line, mode="drop")
-    # LRU stamps cover winners AND joins in one scatter (join refresh at the
-    # hit way; step_no > every earlier stamp so set == max, and same-slot
-    # joiners write identical values)
-    lru_bank = jnp.where(winner | join, bank, B)
-    lru_way = jnp.where(join, llc_hway, llc_uway)
-    llc_lru_n = st.llc_lru.at[lru_bank, bset, lru_way].set(step_no, mode="drop")
-    llc_owner_n = st.llc_owner.at[wbank, bset, llc_uway].set(new_owner, mode="drop")
+    takes_own = write_w | gets_excl_hit | llc_miss
+    eph_rows2 = meta_rows[:, 3 * W2 : 4 * W2]  # [C, W2]
+    eph_way = jnp.where(join, llc_hway, llc_uway)
+    new_eph = eph_rows2[arange_c, eph_way] + takes_own.astype(jnp.int32)
+    # ALL SEVEN L1 writes in ONE scatter on the fused plane array (per-
+    # kernel overhead dominates; see the counters note). Targets are
+    # pairwise distinct: dup_col != upd_col (a duplicate is a different
+    # way than the fill target), hit refresh and grant rows are disjoint
+    # lane classes, and each write addresses its own plane.
+    l1_n = l1_c.at[
+        jnp.stack(
+            [dup_row, dup_row, lru_row, st_row, wj_row, wj_row, wj_row],
+            axis=1,
+        ),
+        jnp.stack(
+            [
+                dup_col,  # stale duplicate tag clear
+                dup_col + FS,  # stale duplicate state clear
+                lru_col + 2 * FS,  # hit refresh / fill LRU stamp
+                st_col + FS,  # silent E->M + grant state
+                upd_col,  # fill tag
+                upd_col + 3 * FS,  # fill way pointer
+                upd_col + 4 * FS,  # fill-time entry epoch (post-bump)
+            ],
+            axis=1,
+        ),
+    ].set(
+        jnp.stack(
+            [
+                jnp.full(C, -1, jnp.int32),
+                jnp.full(C, I, jnp.int32),
+                jnp.broadcast_to(step_no, (C,)),
+                st_val,
+                line,
+                fill_ptr,
+                new_eph,
+            ],
+            axis=1,
+        ),
+        mode="drop",
+    )
+
+    # LLC entry update: ONE full-row scatter writes each winner's whole
+    # tag/owner/LRU metadata row back (collision-free: one winner per
+    # (bank,set); non-winning lanes scatter to the dropped row B*S2) —
+    # the round-4 profile billed ~0.28 ms/step to the three narrow
+    # scatters this replaces. Join LRU refreshes land in a second,
+    # element-wide scatter: join slots never have a winner, so the rows
+    # are disjoint, and same-slot joiners write the identical step stamp.
+    new_owner = jnp.where(takes_own, arange_c, -1)
+    wayeq = jnp.arange(W2, dtype=jnp.int32)[None, :] == llc_uway[:, None]
+    new_meta = jnp.concatenate(
+        [
+            jnp.stack(
+                [
+                    jnp.where(wayeq, line[:, None], llc_tag_rows),
+                    jnp.where(wayeq, new_owner[:, None], owner_rows),
+                ],
+                axis=-1,
+            ).reshape(C, 2 * W2),
+            jnp.where(wayeq, step_no, llc_lru_rows),
+            jnp.where(wayeq, new_eph[:, None], eph_rows2),
+            jnp.zeros((C, st.llc_meta.shape[1] - 4 * W2), jnp.int32),
+        ],
+        axis=1,
+    )
+    wslot = jnp.where(winner, slot, B * S2)
+    llc_meta_n = st.llc_meta.at[wslot].set(new_meta, mode="drop")
+    jslot = jnp.where(join, slot, B * S2)
+    llc_meta_n = llc_meta_n.at[jslot, 2 * W2 + llc_hway].set(
+        step_no, mode="drop"
+    )
 
     # new sharer words [C, NW]
     self_word = (
@@ -704,9 +1178,10 @@ def step(
     # node cannot observe silent L1 evictions (golden does the same), and
     # this keeps the transition free of cross-core L1 reads — which under
     # core-axis sharding would all-gather the L1 arrays every step
+    og_bit = oclamp >> logG  # owner's sharer-GROUP bit (identity at G=1)
     owner_word = jnp.where(
-        jnp.arange(NW)[None, :] == (oclamp // 32)[:, None],
-        jnp.uint32(1) << (oclamp % 32).astype(jnp.uint32)[:, None],
+        jnp.arange(NW)[None, :] == (og_bit // 32)[:, None],
+        jnp.uint32(1) << (og_bit % 32).astype(jnp.uint32)[:, None],
         jnp.uint32(0),
     )
     new_shw = jnp.where(
@@ -775,7 +1250,12 @@ def step(
         lslot = line & (L - 1)
         lreq_lat, lreq_hops = req_lat, req_hops
         lrep_lat, lrep_hops = rep_lat, rep_hops
-        lat_rt = lreq_lat + cfg.llc.latency + lrep_lat + extra_home
+        if router:
+            # raw_rt already reflects this lane's per-class injection
+            # time (pre charged on unlocks and first lock attempts only)
+            lat_rt = raw_rt
+        else:
+            lat_rt = lreq_lat + cfg.llc.latency + lrep_lat + extra_home
 
         # unlocks: every unlock is a charged RMW round trip to the lock's
         # home; the slot is released only if this core actually holds it
@@ -831,8 +1311,9 @@ def step(
         # hoisted above the contention block)
         barr_lat, barr_hops = _one_way(ctile, htile, cfg)
         wake_lat, wake_hops = _one_way(htile, ctile, cfg)
+        barr_charge = raw_arr if router else barr_lat + extra_bar
         cycles = cycles + jnp.where(
-            is_barrier, epre * cpi_vec + barr_lat + extra_bar, 0
+            is_barrier, epre * cpi_vec + barr_charge, 0
         )
         cnt = cadd(cnt, "instructions", jnp.where(is_barrier, epre, 0))
         cnt = cadd(cnt, "barrier_waits", is_barrier)
@@ -872,21 +1353,18 @@ def step(
     return MachineState(
         cycles=cycles,
         ptr=ptr,
-        l1_tag=l1_tag,
-        l1_state=l1_state,
-        l1_lru=l1_lru,
-        l1_ptr=l1_ptr,
-        llc_tag=llc_tag_n,
-        llc_owner=llc_owner_n,
-        llc_lru=llc_lru_n,
+        l1=l1_n,
+        llc_meta=llc_meta_n,
         sharers=sharers_n,
+        link_free=link_free_n,
+        dram_free=dram_free_n,
         lock_holder=lock_holder,
         barrier_count=barrier_count,
         barrier_time=barrier_time,
         sync_flag=sync_flag,
         quantum_end=quantum_end,
         step=step_no + 1,
-        counters=cnt,
+        counters=cflush(cnt),
     )
 
 
@@ -904,6 +1382,18 @@ def run_chunk(
 
     st, _ = jax.lax.scan(body, st, None, length=n_steps)
     return st
+
+
+def _np(x) -> np.ndarray:
+    """Fetch a device array to host NumPy, working under MULTI-HOST
+    sharding too: a cross-process-sharded array is not fully addressable,
+    so it is allgathered first (every process computes the same global
+    result — SPMD — and every process's Engine then reports it)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)
 
 
 def _device_done(events, st, arange_c):
@@ -930,6 +1420,22 @@ def _drain_and_rebase(cfg, st, acc_lo, acc_hi, base_lo, base_hi, nd):
         quantum_end=st.quantum_end - delta,
         barrier_time=jnp.where(
             st.barrier_count > 0, st.barrier_time - delta, st.barrier_time
+        ),
+        # router link clocks are epoch-relative too; the clamp floor is
+        # unreachable by any wait comparison (rank*link_lat < 2^21 and
+        # live clocks are >= 0 post-rebase), so clamping is observably
+        # exact while preventing int32 underflow on long-idle links.
+        # Only shifted when the router model is live — otherwise the
+        # field stays identically zero on every rebase schedule.
+        link_free=(
+            jnp.maximum(st.link_free - delta, -(1 << 30))
+            if cfg.noc.contention and cfg.noc.contention_model == "router"
+            else st.link_free
+        ),
+        dram_free=(
+            jnp.maximum(st.dram_free - delta, -(1 << 30))
+            if cfg.dram_queue
+            else st.dram_free
         ),
     )
     base_lo = base_lo + delta
@@ -1113,7 +1619,7 @@ class Engine:
         self.steps_run = 0
 
     def _drain(self) -> None:
-        cnt = np.asarray(self.state.counters)
+        cnt = _np(self.state.counters)
         for i, k in enumerate(COUNTER_NAMES):
             self.host_counters[k] += cnt[i].astype(np.int64)
         self.state = self.state._replace(
@@ -1121,11 +1627,11 @@ class Engine:
         )
 
     def _event_types_at_ptr(self) -> np.ndarray:
-        p = np.minimum(np.asarray(self.state.ptr), self.trace.max_len - 1)
+        p = np.minimum(_np(self.state.ptr), self.trace.max_len - 1)
         return self.trace.events[np.arange(self.cfg.n_cores), p, 0]
 
     def _rebase(self) -> None:
-        cyc = np.asarray(self.state.cycles)
+        cyc = _np(self.state.cycles)
         nd = self._event_types_at_ptr() != EV_END
         if not nd.any():
             return
@@ -1141,6 +1647,17 @@ class Engine:
                 self.state.barrier_count > 0,
                 self.state.barrier_time - np.int32(delta),
                 self.state.barrier_time,
+            ),
+            link_free=(
+                jnp.maximum(self.state.link_free - np.int32(delta), -(1 << 30))
+                if self.cfg.noc.contention
+                and self.cfg.noc.contention_model == "router"
+                else self.state.link_free
+            ),
+            dram_free=(
+                jnp.maximum(self.state.dram_free - np.int32(delta), -(1 << 30))
+                if self.cfg.dram_queue
+                else self.state.dram_free
             ),
         )
 
@@ -1164,8 +1681,8 @@ class Engine:
             has_sync=self.has_sync,
         )
         # one synchronizing transfer for everything the host needs
-        acc_lo = np.asarray(acc_lo).astype(np.int64)
-        acc_hi = np.asarray(acc_hi).astype(np.int64)
+        acc_lo = _np(acc_lo).astype(np.int64)
+        acc_hi = _np(acc_hi).astype(np.int64)
         total = (acc_hi << _ACC_BITS) + acc_lo
         for i, name in enumerate(COUNTER_NAMES):
             self.host_counters[name] += total[i]
@@ -1242,7 +1759,7 @@ class Engine:
 
     @property
     def cycles(self) -> np.ndarray:
-        return np.asarray(self.state.cycles).astype(np.int64) + self.cycle_base
+        return _np(self.state.cycles).astype(np.int64) + self.cycle_base
 
     @property
     def counters(self) -> dict[str, np.ndarray]:

@@ -22,29 +22,55 @@ from ..stats.counters import COUNTER_NAMES
 I, S, E, M = 0, 1, 2, 3
 
 
+def llc_meta_width(cfg: MachineConfig) -> int:
+    """Padded llc_meta row width: 4*W2 data columns (tag/owner pairs,
+    lru, invalidation epoch) rounded up to a 128-lane multiple so the
+    array tiles row-major (see field note)."""
+    return ((4 * cfg.llc.ways + 127) // 128) * 128
+
+
 class MachineState(NamedTuple):
     # core (CoreManager)
     cycles: jnp.ndarray  # [C] int32 — per-core clock (epoch-relative)
     ptr: jnp.ndarray  # [C] int32 — next trace event index
-    # L1 (private caches). Stored 2D [C, W1*S1] (way-major columns,
-    # column w*S1 + s): with a 3D shape XLA's layout assignment insists on
-    # making the small way dimension minor, and TPU tiling pads the minor
-    # dim to 128 — a 32x memory/bandwidth waste at W1=4. A 2D row of
-    # W1*S1 (>= 512) columns tiles cleanly and leaves XLA nothing to
-    # re-layout.
-    l1_tag: jnp.ndarray  # [C, W1*S1] int32, -1 = invalid
-    l1_state: jnp.ndarray  # [C, W1*S1] int32 MESI (locally-written)
-    l1_lru: jnp.ndarray  # [C, W1*S1] int32 step-stamp
-    # LLC way pointer recorded at fill time: slot*W2 + way of the line's
-    # directory entry. Lets the phase-1 pull-validation use three 1-element
-    # gathers instead of W2-wide tag searches (engine.py `_l1_probe`); a
-    # stale pointer is self-detecting (the pointed tag no longer matches)
-    # and exactly reproduces search validation — see DESIGN.md §7.
-    l1_ptr: jnp.ndarray  # [C, W1*S1] int32
-    # LLC banks + directory
-    llc_tag: jnp.ndarray  # [B, S2, W2] int32, -1 = invalid
-    llc_owner: jnp.ndarray  # [B, S2, W2] int32 core id or -1
-    llc_lru: jnp.ndarray  # [B, S2, W2] int32 step-stamp
+    # L1 (private caches), all five fields FUSED into one array of
+    # planes: plane f at columns [f*W1*S1, (f+1)*W1*S1), in-plane column
+    # w*S1 + s (way-major). Planes: 0 = tag (-1 invalid), 1 = MESI state
+    # (locally-written; see pull-based coherence), 2 = LRU step-stamp,
+    # 3 = LLC way pointer recorded at fill time (slot*W2 + way of the
+    # line's directory entry — phase-1 pull-validation follows it with
+    # element gathers instead of W2-wide tag searches; a stale pointer is
+    # self-detecting, DESIGN.md §7), 4 = the directory entry's
+    # invalidation epoch at fill time (compared by coarse-vector
+    # validation only). Fused because per-step cost on this TPU path is
+    # dominated by per-KERNEL overhead: one take_along over concatenated
+    # plane columns replaces three gathers, and one multi-column scatter
+    # replaces the six L1 update scatters. 2D with a large minor dim
+    # (>= 2560) so tiling stays natural; a 3D shape would make XLA pad
+    # the tiny way dim to 128.
+    l1: jnp.ndarray  # [C, 5*W1*S1] int32
+    # LLC banks + directory metadata, fused: ROW PER (bank, set) — row
+    # slot = bank*S2 + set, columns [2w]=tag, [2w+1]=owner, [2*W2+w]=lru,
+    # [3*W2+w]=invalidation epoch (bumped on every sharer-CLEARING
+    # transition; the coarse sharer vector's pull-validation compares it
+    # against the L1's fill-time record so a neighbor's later re-share
+    # cannot resurrect an invalidated entry), rest zero padding up to
+    # `llc_meta_width` (a 128 multiple). One
+    # FULL-ROW gather (`llc_meta[slot]`, same addressing as the sharers
+    # array) returns the accessed set's tags+owners+LRU stamps in a
+    # single op, and the winner transition writes them back in a single
+    # full-row scatter. Full-row forms are the ones XLA lowers well on
+    # TPU: the round-5 profile showed whole-row gather/scatter at ~0.02-
+    # 0.1 ms while windowed (dynamic column offset) forms cost 2-4 ms and
+    # three narrow [B,S2,W2] scatters cost 0.28 ms. The EXPLICIT pad to a
+    # 128-lane minor dim matters as much as the form: at 3*W2 (=24)
+    # columns XLA's layout assignment flips the array to a
+    # dim0-minor physical layout (transposing beats 5x pad in its cost
+    # model), which turns every logical row into a strided walk across
+    # tiles — the compiled HLO showed {0,1:T(8,128)} and the phase
+    # profile billed ~2 ms/step to meta traffic until the pad forced the
+    # natural row-major tiling back.
+    llc_meta: jnp.ndarray  # [B*S2, llc_meta_width(cfg)] int32
     # Directory sharer bit-vectors, stored row-per-(bank,set) with the way
     # axis folded into columns: row slot b*S2+s, columns [w*NW, (w+1)*NW).
     # Kept 2D so XLA settles on ONE layout for it — the natural
@@ -53,6 +79,14 @@ class MachineState(NamedTuple):
     # costing two full copies per step. (At the 1024-core flagship config
     # the minor dim is also a 128 multiple, which tiles without padding.)
     sharers: jnp.ndarray  # [B*S2, W2*NW] uint32 packed sharer bits
+    # hop-by-hop router (contention_model="router"): per-directed-link
+    # next-free clock, epoch-relative, carried across steps; rebased with
+    # the core clocks (clamped at -(1<<30) — a clock that far in the past
+    # can never influence a wait, so the clamp is observably exact)
+    link_free: jnp.ndarray  # [n_tiles*4] int32
+    # memory-controller queueing (cfg.dram_queue): per-bank next-free
+    # clock, same epoch/rebase/clamp treatment as link_free
+    dram_free: jnp.ndarray  # [B] int32
     # synchronization state (DESIGN.md §3 phase 2.7)
     lock_holder: jnp.ndarray  # [lock_slots] int32 core id or -1
     barrier_count: jnp.ndarray  # [barrier_slots] int32 arrivals this round
@@ -78,14 +112,26 @@ def init_state(cfg: MachineConfig) -> MachineState:
     return MachineState(
         cycles=jnp.zeros(C, jnp.int32),
         ptr=jnp.zeros(C, jnp.int32),
-        l1_tag=jnp.full((C, w1 * s1), -1, jnp.int32),
-        l1_state=jnp.full((C, w1 * s1), I, jnp.int32),
-        l1_lru=jnp.zeros((C, w1 * s1), jnp.int32),
-        l1_ptr=jnp.zeros((C, w1 * s1), jnp.int32),
-        llc_tag=jnp.full((B, s2, w2), -1, jnp.int32),
-        llc_owner=jnp.full((B, s2, w2), -1, jnp.int32),
-        llc_lru=jnp.zeros((B, s2, w2), jnp.int32),
+        l1=jnp.concatenate(
+            [
+                jnp.full((C, w1 * s1), -1, jnp.int32),  # tag plane
+                jnp.full((C, w1 * s1), I, jnp.int32),  # state plane
+                jnp.zeros((C, 3 * w1 * s1), jnp.int32),  # lru/ptr/epoch
+            ],
+            axis=1,
+        ),
+        llc_meta=jnp.concatenate(
+            [
+                jnp.full((B * s2, 2 * w2), -1, jnp.int32),  # tag/owner
+                jnp.zeros(
+                    (B * s2, llc_meta_width(cfg) - 2 * w2), jnp.int32
+                ),  # lru stamps + tiling pad
+            ],
+            axis=1,
+        ),
         sharers=jnp.zeros((B * s2, w2 * nw), jnp.uint32),
+        link_free=jnp.zeros(cfg.n_tiles * 4, jnp.int32),
+        dram_free=jnp.zeros(B, jnp.int32),
         lock_holder=jnp.full(cfg.lock_slots, -1, jnp.int32),
         barrier_count=jnp.zeros(cfg.barrier_slots, jnp.int32),
         barrier_time=jnp.zeros(cfg.barrier_slots, jnp.int32),

@@ -18,10 +18,55 @@ from .state import E, I, M, S  # noqa: F401  (shared MESI encoding)
 
 
 def engine_l1_to_golden(cfg: MachineConfig, arr: np.ndarray) -> np.ndarray:
-    """Reshape an engine L1 array [C, W1*S1] to golden layout [C, S1, W1]."""
+    """Reshape an engine L1 plane [C, W1*S1] to golden layout [C, S1, W1]."""
     C = arr.shape[0]
     W1, S1 = cfg.l1.ways, cfg.l1.sets
     return np.transpose(arr.reshape(C, W1, S1), (0, 2, 1))
+
+
+def l1_views(cfg: MachineConfig, state):
+    """Split the engine's fused L1 array into its four planes.
+
+    Returns (tag, state, lru, ptr), each [C, W1*S1] (engine way-major
+    column layout; feed through `engine_l1_to_golden` for the golden's
+    [C, S1, W1] layout).
+    """
+    arr = np.asarray(state.l1)
+    FS = cfg.l1.ways * cfg.l1.sets
+    return (
+        arr[:, :FS],
+        arr[:, FS : 2 * FS],
+        arr[:, 2 * FS : 3 * FS],
+        arr[:, 3 * FS : 4 * FS],
+    )
+
+
+def epoch_views(cfg: MachineConfig, state):
+    """The invalidation-epoch planes (coarse-vector validation inputs):
+    (l1_eph [C, W1*S1], llc_eph [B, S2, W2])."""
+    FS = cfg.l1.ways * cfg.l1.sets
+    W2, S2, B = cfg.llc.ways, cfg.llc.sets, cfg.n_banks
+    l1_eph = np.asarray(state.l1)[:, 4 * FS : 5 * FS]
+    llc_eph = np.asarray(state.llc_meta)[:, 3 * W2 : 4 * W2].reshape(
+        B, S2, W2
+    )
+    return l1_eph, llc_eph
+
+
+def llc_views(cfg: MachineConfig, state):
+    """Unpack the engine's fused LLC metadata into golden-layout views.
+
+    The engine stores the whole per-(bank,set) LLC metadata in one
+    `llc_meta` row (row slot = bank*S2 + set; columns [2w]=tag,
+    [2w+1]=owner, [2*W2+w]=lru); returns (llc_tag, llc_owner, llc_lru)
+    as [B, S2, W2] NumPy arrays, the golden model's layout.
+    """
+    B = cfg.n_banks
+    S2, W2 = cfg.llc.sets, cfg.llc.ways
+    meta = np.asarray(state.llc_meta)
+    pairs = meta[:, : 2 * W2].reshape(B, S2, W2, 2)
+    lru = meta[:, 2 * W2 : 3 * W2].reshape(B, S2, W2)
+    return pairs[..., 0], pairs[..., 1], lru
 
 
 def effective_l1_state(
@@ -31,6 +76,8 @@ def effective_l1_state(
     llc_tag: np.ndarray,  # [B, S2, W2]
     llc_owner: np.ndarray,  # [B, S2, W2]
     sharers: np.ndarray,  # [B*S2, W2*NW] packed rows (engine layout)
+    l1_eph: np.ndarray | None = None,  # [C, W1*S1] fill epochs (coarse)
+    llc_eph: np.ndarray | None = None,  # [B, S2, W2] entry epochs (coarse)
 ) -> np.ndarray:
     """Directory-validated MESI state per L1 way (engine phase-1 rule).
 
@@ -47,6 +94,7 @@ def effective_l1_state(
     ltag2 = llc_tag.reshape(B * S2, W2)
     lown2 = llc_owner.reshape(B * S2, W2)
     sh3 = sharers.reshape(B * S2, W2, NW)
+    logG = cfg.sharer_group.bit_length() - 1
 
     slot = (l1_tag & (B - 1)) * S2 + ((l1_tag >> logB) & (S2 - 1))  # [C,S1,W1]
     tags = ltag2[slot]  # [C,S1,W1,W2]
@@ -55,13 +103,27 @@ def effective_l1_state(
     hway = match.argmax(-1)
     owner = np.take_along_axis(lown2[slot], hway[..., None], -1)[..., 0]
     cores = np.arange(C, dtype=np.int64)[:, None, None]
+    gbit = cores >> logG  # sharer-GROUP bit index (identity at G=1)
     word = np.take_along_axis(
         sh3[slot],  # [C,S1,W1,W2,NW]
-        np.broadcast_to((cores >> 5), slot.shape)[..., None, None],
+        np.broadcast_to((gbit >> 5), slot.shape)[..., None, None],
         -1,
     )[..., 0]  # [C,S1,W1,W2]
     shword = np.take_along_axis(word, hway[..., None], -1)[..., 0]
-    shbit = ((shword >> (cores & 31).astype(np.uint32)) & 1) != 0
+    shbit = ((shword >> (gbit & 31).astype(np.uint32)) & 1) != 0
+    if cfg.sharer_group > 1:
+        # coarse vector: the group bit only validates an entry filled at
+        # the directory entry's CURRENT invalidation epoch (engine.py
+        # `_validate_ways` — a neighbor's re-share must not resurrect an
+        # invalidated copy)
+        if l1_eph is None or llc_eph is None:
+            raise ValueError(
+                "sharer_group > 1 requires l1_eph/llc_eph for validation"
+            )
+        l1_eph = engine_l1_to_golden(cfg, l1_eph)
+        eph2 = llc_eph.reshape(B * S2, W2)
+        veph = np.take_along_axis(eph2[slot], hway[..., None], -1)[..., 0]
+        shbit = shbit & (veph == l1_eph)
 
     return np.where(
         (l1_state == I) | ~has,
@@ -88,10 +150,8 @@ def check_invariants(cfg: MachineConfig, state, done_mask=None) -> None:
             raise AssertionError(msg)
 
     C = cfg.n_cores
-    l1_tag = np.asarray(state.l1_tag)
-    l1_state = np.asarray(state.l1_state)
-    llc_tag = np.asarray(state.llc_tag)
-    llc_owner = np.asarray(state.llc_owner)
+    l1_tag, l1_state, _, _ = l1_views(cfg, state)
+    llc_tag, llc_owner, _ = llc_views(cfg, state)
     sharers = np.asarray(state.sharers)
     B, S2, W2 = llc_tag.shape
     NW = cfg.n_sharer_words
@@ -109,13 +169,14 @@ def check_invariants(cfg: MachineConfig, state, done_mask=None) -> None:
         ((llc_owner >= -1) & (llc_owner < C)).all(),
         "invariant: llc_owner out of range",
     )
-    if C % 32:
+    n_grp = cfg.n_sharer_groups
+    if n_grp % 32:
         bits = (
             (sh3[..., None] >> np.arange(32, dtype=np.uint32)) & 1
         ).reshape(B * S2, W2, NW * 32)
         _require(
-            not (bits[:, :, C:] != 0).any(),
-            "invariant: sharer bits set beyond core count",
+            not (bits[:, :, n_grp:] != 0).any(),
+            "invariant: sharer bits set beyond the group count",
         )
 
     # 3. valid LLC tags unique per (bank, set)
@@ -135,7 +196,13 @@ def check_invariants(cfg: MachineConfig, state, done_mask=None) -> None:
             _require(not clash.any(), "invariant: duplicate valid L1 tag in set")
 
     # 5. effective E/M exclusivity: at most one core holds a line in E/M
-    eff = effective_l1_state(cfg, l1_tag, l1_state, llc_tag, llc_owner, sharers)
+    l1_eph, llc_eph = (
+        epoch_views(cfg, state) if cfg.sharer_group > 1 else (None, None)
+    )
+    eff = effective_l1_state(
+        cfg, l1_tag, l1_state, llc_tag, llc_owner, sharers,
+        l1_eph=l1_eph, llc_eph=llc_eph,
+    )
     em = eff >= E
     em_lines = gt[em]
     _require(

@@ -34,6 +34,7 @@ COUNTER_NAMES = (
     "lock_spins",      # failed LOCK attempts (charged spin round trips)
     "barrier_waits",   # BARRIER arrivals
     "noc_contention_cycles",  # router-occupancy queueing cycles charged
+    "dram_queue_cycles",  # memory-controller queueing waits (dram_queue)
 )
 
 

@@ -28,13 +28,13 @@ VARIANTS = {
         ('sharers_n = st.sharers.at[upd_slot].add(delta_row, mode="drop")',
          "sharers_n = st.sharers"),
     ],
-    "no_llc_scatter": [
-        ('llc_tag_n = st.llc_tag.at[wbank, bset, llc_uway].set(line, mode="drop")',
-         "llc_tag_n = st.llc_tag"),
-        ('llc_lru_n = st.llc_lru.at[lru_bank, bset, lru_way].set(step_no, mode="drop")',
-         "llc_lru_n = st.llc_lru"),
-        ('llc_owner_n = st.llc_owner.at[wbank, bset, llc_uway].set(new_owner, mode="drop")',
-         "llc_owner_n = st.llc_owner"),
+    "no_meta_scatter": [
+        ('    llc_meta_n = st.llc_meta.at[wslot].set(new_meta, mode="drop")',
+         "    llc_meta_n = st.llc_meta"),
+    ],
+    "no_joinlru_scatter": [
+        ("    llc_meta_n = llc_meta_n.at[jslot, 2 * W2 + llc_hway].set(\n        step_no, mode=\"drop\"\n    )",
+         "    llc_meta_n = llc_meta_n"),
     ],
     "no_unpack_CC": [
         ("        sh_bits = unpack_bits(shw)",
@@ -42,55 +42,45 @@ VARIANTS = {
         ("        vic_sh_bits = unpack_bits(vic_shw)",
          "        vic_sh_bits = jnp.zeros((C, C), bool)"),
     ],
-    "no_CC_reductions": [
-        ("        inv_lat = jnp.max(jnp.where(inv_pairs, 2 * pair_lat, 0), axis=1)",
-         "        inv_lat = jnp.zeros(C, jnp.int32)"),
-        ("        inv_count = jnp.sum(inv_pairs, axis=1).astype(jnp.int32)",
-         "        inv_count = jnp.zeros(C, jnp.int32)"),
-        ("        inv_hops = jnp.sum(jnp.where(inv_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
-         "        inv_hops = jnp.zeros(C, jnp.int32)"),
-        ("        back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)",
-         "        back_count = jnp.zeros(C, jnp.int32)"),
-        ("        back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)",
-         "        back_hops = jnp.zeros(C, jnp.int32)"),
-    ],
     "no_arb_table": [
         ('    table = table.at[jnp.where(req, slot, B * S2)].min(key, mode="drop")',
          "    table = table"),
         ('    table = table.at[jnp.where(demoted, slot, B * S2)].min(key, mode="drop")',
          "    table = table"),
     ],
-    "no_l1_scatters": [
-        ('    l1_tag = st.l1_tag.at[dup_row, dup_col].set(-1, mode="drop")',
-         "    l1_tag = st.l1_tag"),
-        ('    l1_state = l1_state_c.at[dup_row, dup_col].set(I, mode="drop")',
-         "    l1_state = l1_state_c"),
-        ('    l1_lru = l1_lru_c.at[lru_row, lru_col].set(step_no, mode="drop")',
-         "    l1_lru = l1_lru_c"),
-        ('    l1_state = l1_state.at[st_row, st_col].set(st_val, mode="drop")',
-         "    l1_state = l1_state"),
-        ('    l1_tag = l1_tag.at[wj_row, upd_col].set(line, mode="drop")',
-         "    l1_tag = l1_tag"),
+    "no_l1_scatter": [
+        ("    l1_n = l1_c.at[", "    l1_n = l1_c; _dead = l1_c.at["),
     ],
-    "no_l1ptr_write": [
-        ('    l1_ptr = st.l1_ptr.at[wj_row, upd_col].set(fill_ptr, mode="drop")',
-         "    l1_ptr = st.l1_ptr"),
+    "no_run_l1_scatter": [
+        ("        l1_c = l1_c.at[", "        _deadrun = l1_c.at["),
     ],
     "no_ptr_gathers": [
-        ("    vtag = llc_tag[pbank, pbset, pway]  # [C, W1]",
+        ("    vtag = llc_meta[pslot, 2 * pway]  # [C, W1]",
          "    vtag = tag_rows"),
-        ("    vown = llc_owner[pbank, pbset, pway]",
+        ("    vown = llc_meta[pslot, 2 * pway + 1]",
          "    vown = jnp.broadcast_to(arange_c[:, None], tag_rows.shape)"),
-        ("    vsh = sharers[pslot, pway * NW + (arange_c[:, None] >> 5)]",
+        ("    vsh = sharers[pslot, pway * NW + (g_c[:, None] >> 5)]",
          "    vsh = jnp.zeros(tag_rows.shape, jnp.uint32)"),
     ],
     "no_phase1_validation": [
-        ("    weff = jnp.where(\n        (state_rows == I) | (vtag != tag_rows),\n        I,\n        jnp.where(\n            vown == arange_c[:, None],\n            state_rows,\n            jnp.where(vbit, S, I),\n        ),\n    )  # [C, W1] effective MESI per way",
-         "    weff = state_rows"),
+        ("    return jnp.where(\n        (state_rows == I) | (vtag != tag_rows),\n        I,\n        jnp.where(\n            vown == arange_c[:, None],\n            state_rows,\n            jnp.where(vbit, S, I),\n        ),\n    )  # [C, W1] effective MESI per way",
+         "    return state_rows"),
+    ],
+    "no_metarows_gather": [
+        ("    meta_rows = st.llc_meta[slot]  # [C, MW]",
+         "    meta_rows = jnp.full((C, st.llc_meta.shape[1]), -1, jnp.int32)"),
     ],
     "no_shrows_gather": [
         ("    sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]",
          "    sh_rows = jnp.zeros((C, W2, NW), jnp.uint32)"),
+    ],
+    "no_run_prefetch_meta": [
+        ("        pmrows = st.llc_meta[pslot]  # [C, rl+1, MW]",
+         "        pmrows = jnp.full((C, rl + 1, st.llc_meta.shape[1]), -1, jnp.int32)"),
+    ],
+    "no_run_prefetch_shw": [
+        ("        pshw = st.sharers[pslot, pmway * NW + (g_c0[:, None] >> 5)]",
+         "        pshw = jnp.zeros((C, rl + 1), jnp.uint32)"),
     ],
 }
 
@@ -110,12 +100,16 @@ def build(name):
 
 
 def main():
+    import os
+
     C = 1024
+    rl = int(os.environ.get("PRIMETPU_PROF_RL", "0"))
     cfg = MachineConfig(n_cores=C, n_banks=C,
         l1=CacheConfig(size=32 * 1024, ways=4, line=64, latency=2),
         llc=CacheConfig(size=256 * 1024, ways=8, line=64, latency=10),
         noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
-        dram_lat=100, quantum=1000)
+        dram_lat=100, quantum=1000, local_run_len=rl)
+    print(f"local_run_len={rl}")
     trace = fold_ins(synth.fft_like(C, n_phases=2, points_per_core=16, ins_per_mem=8, seed=42))
     events = jnp.asarray(trace.line_events(cfg.line_bits))
     n = 256

@@ -1,0 +1,105 @@
+"""Cumulative phase bisect: truncate engine.step at successive phase
+markers and time each prefix — pinpoints which PHASE the per-step
+milliseconds live in (prof_bisect ablates single ops; this localizes).
+
+Source surgery like prof_bisect: each cut keeps everything computed so
+far alive via a data-dependent guard (so DCE can't erase the phase) and
+returns a well-formed MachineState. TIMING tool only — simulated
+behavior diverges beyond the cut.
+"""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import primesim_tpu.sim.engine as eng_mod
+from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+from primesim_tpu.sim.state import init_state
+from primesim_tpu.trace import synth
+from primesim_tpu.trace.format import fold_ins
+
+SRC = open(eng_mod.__file__).read()
+
+# (name, marker to cut just BEFORE, keep-alive expression over live vars)
+CUTS = [
+    ("p0_quantum", "# ---- phase 0.5", "quantum_end"),
+    ("p05_localrun", "# ---- phase 0.9", "cycles_c + ptr_c"),
+    ("p09_arbevent", "# ---- phase 1:", "cycles_c + ptr_c + et + eaddr"),
+    ("p1_probe", "# LLC lookup for the accessed line",
+     "cycles_c + ptr_c + weff.sum(1) + hit_way + et"),
+    ("p1_llcrows", "# ---- phase 2:",
+     "cycles_c + ptr_c + weff.sum(1) + llc_hway + owner + self_bit + et"),
+    ("p2_arb", "# ---- phase 3:",
+     "cycles_c + ptr_c + weff.sum(1) + owner + winner + join + retry + et"),
+    ("p3_inv", "# --- latency composition",
+     "cycles_c + ptr_c + weff.sum(1) + winner + inv_lat + inv_count"
+     " + back_count + vic_owner + et"),
+    ("p3_lat", "# --- granted L1 state",
+     "cycles_c + ptr_c + weff.sum(1) + winner + lat + lat_join + et"),
+    ("p4_counters", "# ---- phase 4.A",
+     "cycles_c + ptr_c + weff.sum(1) + winner + lat + noc_msgs + et"),
+    ("p4a_l1", "# LLC entry update",
+     "cycles + ptr + l1_n.sum(1) + lat"),
+    ("full", None, None),
+]
+
+RET = """
+    _keep = {keep}
+    _g = jnp.where(jnp.sum(_keep) == jnp.int32(-123454321), 1, 0)
+    return st._replace(cycles=st.cycles + _g, step=step_no + 1)
+"""
+
+
+def build(name, marker, keep):
+    src = SRC
+    if marker is not None:
+        i = src.index(marker)
+        # cut at the start of the marker's line
+        i = src.rfind("\n", 0, i) + 1
+        src = src[:i] + RET.format(keep=keep)
+        # keep module-level code after step(): find next top-level def in
+        # ORIGINAL source and append everything from there
+        j = SRC.index("\n@functools.partial(")
+        src = src + SRC[j:]
+    ns = {
+        "__name__": f"primesim_tpu.sim.engine_{name}",
+        "__package__": "primesim_tpu.sim",
+        "__file__": eng_mod.__file__,
+    }
+    exec(compile(src, eng_mod.__file__, "exec"), ns)
+    return ns["run_chunk"]
+
+
+def main():
+    C = 1024
+    rl = int(os.environ.get("PRIMETPU_PROF_RL", "8"))
+    cfg = MachineConfig(n_cores=C, n_banks=C,
+        l1=CacheConfig(size=32 * 1024, ways=4, line=64, latency=2),
+        llc=CacheConfig(size=256 * 1024, ways=8, line=64, latency=10),
+        noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
+        dram_lat=100, quantum=1000, local_run_len=rl)
+    print(f"local_run_len={rl}")
+    trace = fold_ins(synth.fft_like(C, n_phases=2, points_per_core=16,
+                                    ins_per_mem=8, seed=42))
+    events = jnp.asarray(trace.line_events(cfg.line_bits))
+    n = 256
+    prev = 0.0
+    for name, marker, keep in CUTS:
+        rc = build(name, marker, keep)
+        st = init_state(cfg)
+        out = rc(cfg, n, events, st)
+        np.asarray(out.step)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = rc(cfg, n, events, out)
+        np.asarray(out.step)
+        dt = (time.perf_counter() - t0) / 3 / n
+        print(f"[{name:14s}] {dt*1e3:7.3f} ms/step  (+{(dt-prev)*1e3:6.3f})",
+              flush=True)
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()

@@ -76,23 +76,24 @@ def test_checker_detects_violations():
     eng.run()
     check_invariants(cfg, eng.state)  # clean state passes
 
-    # owned entry with sharers recorded
+    # owned entry with sharers recorded (fused llc_meta layout: column
+    # (set*W2 + way)*2 holds the tag, +1 the owner — (bank 0, set 0,
+    # way 0) is columns 0/1)
     bad = eng.state._replace(
-        llc_owner=eng.state.llc_owner.at[0, 0, 0].set(1),
+        llc_meta=eng.state.llc_meta.at[0, 0].set(12345).at[0, 1].set(1),
         sharers=eng.state.sharers.at[0, 0].set(jnp.uint32(0b11)),
-        llc_tag=eng.state.llc_tag.at[0, 0, 0].set(12345),
     )
     with pytest.raises(AssertionError, match="sharer set"):
         check_invariants(cfg, bad)
 
     # out-of-range owner
-    bad = eng.state._replace(llc_owner=eng.state.llc_owner.at[0, 0, 0].set(99))
+    bad = eng.state._replace(llc_meta=eng.state.llc_meta.at[0, 1].set(99))
     with pytest.raises(AssertionError, match="out of range"):
         check_invariants(cfg, bad)
 
-    # duplicate valid LLC tag within a set
+    # duplicate valid LLC tag within a set (ways 0 and 1 -> columns 0, 2)
     bad = eng.state._replace(
-        llc_tag=eng.state.llc_tag.at[0, 0, 0].set(777).at[0, 0, 1].set(777)
+        llc_meta=eng.state.llc_meta.at[0, 0].set(777).at[0, 2].set(777)
     )
     with pytest.raises(AssertionError, match="duplicate valid LLC tag"):
         check_invariants(cfg, bad)
@@ -123,7 +124,11 @@ def test_em_exclusivity_is_structural():
     future derivation changes. This test pins the self-healing behavior.
     """
     from primesim_tpu.sim.state import init_state
-    from primesim_tpu.sim.validate import effective_l1_state
+    from primesim_tpu.sim.validate import (
+        effective_l1_state,
+        l1_views,
+        llc_views,
+    )
 
     cfg = small_test_config(4)
     st = init_state(cfg)
@@ -131,25 +136,32 @@ def test_em_exclusivity_is_structural():
     b, s2 = line % cfg.n_banks, (line // cfg.n_banks) % cfg.llc.sets
     l1s = line % cfg.l1.sets
     M = 3
+    FS = cfg.l1.ways * cfg.l1.sets  # fused-L1 plane stride
     entry_ptr = (b * cfg.llc.sets + s2) * cfg.llc.ways
+    mrow = b * cfg.llc.sets + s2  # llc_meta row slot; way-0 tag/owner cols 0/1
+    l1 = st.l1
+    for c in (0, 1):
+        l1 = (
+            l1.at[c, l1s].set(line)  # tag plane, way 0
+            .at[c, FS + l1s].set(M)  # state plane
+            .at[c, 3 * FS + l1s].set(entry_ptr)  # ptr plane
+        )
     st = st._replace(
-        llc_tag=st.llc_tag.at[b, s2, 0].set(line),
-        llc_owner=st.llc_owner.at[b, s2, 0].set(0),
-        l1_tag=st.l1_tag.at[0, l1s].set(line).at[1, l1s].set(line),
-        l1_state=st.l1_state.at[0, l1s].set(M).at[1, l1s].set(M),
-        l1_ptr=st.l1_ptr.at[0, l1s].set(entry_ptr).at[1, l1s].set(entry_ptr),
+        llc_meta=st.llc_meta.at[mrow, 0].set(line).at[mrow, 1].set(0),
+        l1=l1,
     )
 
     def em_holders(state):
+        tag_v, own_v, _ = llc_views(cfg, state)
+        l1_tag_v, l1_state_v, _, _ = l1_views(cfg, state)
         eff = effective_l1_state(
-            cfg, np.asarray(state.l1_tag), np.asarray(state.l1_state),
-            np.asarray(state.llc_tag), np.asarray(state.llc_owner),
-            np.asarray(state.sharers),
+            cfg, l1_tag_v, l1_state_v,
+            tag_v, own_v, np.asarray(state.sharers),
         )
         return sorted(set(np.nonzero((eff >= 2).any(axis=(1, 2)))[0].tolist()))
 
     check_invariants(cfg, st)
     assert em_holders(st) == [0]  # owner 0 holds M; core 1 validates to I
-    flipped = st._replace(llc_owner=st.llc_owner.at[b, s2, 0].set(1))
+    flipped = st._replace(llc_meta=st.llc_meta.at[mrow, 1].set(1))
     check_invariants(cfg, flipped)  # still consistent: ownership moved
     assert em_holders(flipped) == [1]

@@ -60,7 +60,7 @@ def test_state_is_actually_sharded():
     e = Engine(cfg, trace, mesh=mesh)
     shardings = {
         "cycles": e.state.cycles.sharding,
-        "llc_tag": e.state.llc_tag.sharding,
+        "llc_meta": e.state.llc_meta.sharding,
         "events": e.events.sharding,
     }
     for name, s in shardings.items():
@@ -92,3 +92,63 @@ def test_global_tile_mesh_single_process():
     g = GoldenSim(cfg, tr)
     g.run()
     np.testing.assert_array_equal(e.cycles, g.cycles)
+
+
+def test_sharded_parity_256core():
+    # VERDICT r4 #7: multi-chip correctness beyond toy shapes — 256 cores
+    # / 256 banks sharded over all 8 devices, bit-exact vs the golden
+    # scalar model (and transitively vs the unsharded engine, proven by
+    # the other parity suites on the same generators)
+    from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+
+    cfg = MachineConfig(
+        n_cores=256, n_banks=256,
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=4096, ways=4, line=64, latency=12),
+        noc=NocConfig(mesh_x=16, mesh_y=16),
+        quantum=600,
+    )
+    tr = synth.readers_writer(256, n_rounds=2, block_lines=4, seed=93)
+    e = Engine(cfg, tr, chunk_steps=64, mesh=tile_mesh(8))
+    e.run()
+    g = GoldenSim(cfg, tr)
+    g.run()
+    np.testing.assert_array_equal(e.cycles, g.cycles)
+    ec = e.counters
+    for k, v in g.counters.items():
+        np.testing.assert_array_equal(ec[k], v, err_msg=k)
+
+
+def test_sharded_step_never_allgathers_directory():
+    # the round-2 regression's failure mode: a layout/sharding slip that
+    # makes XLA materialize the FULL sharers/llc_meta array on every
+    # device each step. Compile the sharded chunk and assert no
+    # all-gather/all-reduce touches a directory-shaped operand.
+    import re
+
+    from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
+    from primesim_tpu.parallel.sharding import shard_events, shard_state
+    from primesim_tpu.sim.engine import run_chunk
+    from primesim_tpu.sim.state import init_state
+
+    cfg = MachineConfig(
+        n_cores=256, n_banks=256,
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=4096, ways=4, line=64, latency=12),
+        noc=NocConfig(mesh_x=16, mesh_y=16),
+        quantum=600,
+    )
+    tr = synth.false_sharing(256, n_mem_ops=8, seed=94)
+    mesh = tile_mesh(8)
+    import jax.numpy as jnp
+
+    events = shard_events(mesh, jnp.asarray(tr.line_events(cfg.line_bits)))
+    st = shard_state(mesh, init_state(cfg))
+    txt = run_chunk.lower(cfg, 4, events, st, has_sync=False).compile().as_text()
+    B_S2 = cfg.n_banks * cfg.llc.sets  # full (unsharded) leading dim
+    bad = [
+        l
+        for l in txt.splitlines()
+        if re.search(r"all-gather|all-reduce", l) and f"[{B_S2}," in l
+    ]
+    assert not bad, "directory arrays all-gathered:\n" + "\n".join(bad[:5])

@@ -10,7 +10,14 @@ import pytest
 
 from primesim_tpu.config.machine import CacheConfig, MachineConfig, NocConfig
 from primesim_tpu.golden.sim import GoldenSim
-from primesim_tpu.sim.validate import I, effective_l1_state, engine_l1_to_golden
+from primesim_tpu.sim.validate import (
+    I,
+    effective_l1_state,
+    engine_l1_to_golden,
+    epoch_views,
+    l1_views,
+    llc_views,
+)
 from primesim_tpu.trace import synth
 
 
@@ -43,26 +50,31 @@ def assert_parity(cfg, trace, chunk_steps=64):
     # directory-VALIDATED state at every way, with matching tags wherever
     # the golden holds a valid line. This is the empirical proof of the
     # eager/pull equivalence (DESIGN.md §7).
+    e_llc_tag, e_llc_owner, e_llc_lru = llc_views(cfg, e.state)
+    e_l1_tag2, e_l1_state2, e_l1_lru2, _ = l1_views(cfg, e.state)
+    e_l1_eph, e_llc_eph = (
+        epoch_views(cfg, e.state) if cfg.sharer_group > 1 else (None, None)
+    )
     eff = effective_l1_state(
         cfg,
-        np.asarray(e.state.l1_tag),
-        np.asarray(e.state.l1_state),
-        np.asarray(e.state.llc_tag),
-        np.asarray(e.state.llc_owner),
+        e_l1_tag2,
+        e_l1_state2,
+        e_llc_tag,
+        e_llc_owner,
         np.asarray(e.state.sharers),
+        l1_eph=e_l1_eph,
+        llc_eph=e_llc_eph,
     )
     np.testing.assert_array_equal(eff, g.l1_state, err_msg="effective l1_state")
     valid = g.l1_state != I
-    e_l1_tag = engine_l1_to_golden(cfg, np.asarray(e.state.l1_tag))
+    e_l1_tag = engine_l1_to_golden(cfg, e_l1_tag2)
     np.testing.assert_array_equal(
         np.where(valid, e_l1_tag, -1),
         np.where(valid, g.l1_tag, -1),
         err_msg="l1_tag (valid ways)",
     )
-    np.testing.assert_array_equal(np.asarray(e.state.llc_tag), g.llc_tag, err_msg="llc_tag")
-    np.testing.assert_array_equal(
-        np.asarray(e.state.llc_owner), g.llc_owner, err_msg="llc_owner"
-    )
+    np.testing.assert_array_equal(e_llc_tag, g.llc_tag, err_msg="llc_tag")
+    np.testing.assert_array_equal(e_llc_owner, g.llc_owner, err_msg="llc_owner")
     # engine stores sharers row-per-(bank,set) with ways folded into columns
     np.testing.assert_array_equal(
         np.asarray(e.state.sharers).reshape(g.sharers.shape),
@@ -84,13 +96,11 @@ def assert_parity(cfg, trace, chunk_steps=64):
         np.testing.assert_array_equal(ec[k], v, err_msg=f"counter {k}")
     # LRU parity (modulo int width): compare where entries are valid
     np.testing.assert_array_equal(
-        engine_l1_to_golden(cfg, np.asarray(e.state.l1_lru)),
+        engine_l1_to_golden(cfg, e_l1_lru2),
         g.l1_lru,
         err_msg="l1_lru",
     )
-    np.testing.assert_array_equal(
-        np.asarray(e.state.llc_lru), g.llc_lru, err_msg="llc_lru"
-    )
+    np.testing.assert_array_equal(e_llc_lru, g.llc_lru, err_msg="llc_lru")
 
 
 GENS = {

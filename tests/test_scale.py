@@ -163,3 +163,35 @@ def test_4096core_step_runs_chunked():
     st = run_chunk(cfg, 8, events, init_state(cfg), has_sync=False)
     assert int(st.step) == 8
     assert int(jnp.sum(st.counters)) > 0  # work actually happened
+
+
+def test_16384core_step_runs_coarse():
+    # BASELINE rung 5 scale (VERDICT r4 #5): with the full-map vector this
+    # machine's sharer array alone is 256 GiB — the coarse vector (G=64,
+    # 256 group bits) plus group-table reductions make the 16384-core step
+    # executable on ONE chip. Small caches keep the CI footprint modest;
+    # the shipped configs/rung5_16384core_wafer.json carries the full
+    # geometry with the same sharer_group.
+    import jax.numpy as jnp
+
+    from primesim_tpu.config.machine import CoreConfig
+    from primesim_tpu.sim.engine import run_chunk
+    from primesim_tpu.sim.state import init_state
+
+    C = 16384
+    cfg = MachineConfig(
+        n_cores=C,
+        n_banks=256,
+        core=CoreConfig(o3_overlap_256=128),
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=8192, ways=4, line=64, latency=16),
+        noc=NocConfig(mesh_x=16, mesh_y=16),
+        quantum=1000,
+        sharer_group=64,
+    )
+    assert cfg.n_sharer_words == 8  # 256 groups, not 16384 bits
+    tr = synth.false_sharing(C, n_mem_ops=4, n_hot_lines=2, seed=65)
+    events = jnp.asarray(tr.line_events(cfg.line_bits))
+    st = run_chunk(cfg, 4, events, init_state(cfg), has_sync=False)
+    assert int(st.step) == 4
+    assert int(jnp.sum(st.counters)) > 0
