@@ -126,6 +126,34 @@ def main() -> None:
                     "local_run_len": RL,
                     "chunk_steps": CHUNK,
                     "rung3_shipped_config": detail_r3,
+                    # STATIC RECORD: round-5 restructure evidence measured
+                    # on TPU 2026-07-30 (prof_phase.py cumulative cuts /
+                    # prof_bisect.py ablations, flagship shapes, rl=8).
+                    # Per-KERNEL overhead dominates this workload; the
+                    # remaining floor is the step's serial kernel chain.
+                    "perf_evidence_static_r5": {
+                        "phase_ms_cuts_rl8": {
+                            "quantum": 0.09, "local_runs": 0.16,
+                            "probe+classify": 0.8, "arb+inv+lat": 0.3,
+                            "scatters+tail": 1.0,
+                        },
+                        "landed": {
+                            "closed_form_local_runs_ms": 0.7,
+                            "fused_l1_single_scatter": True,
+                            "fused_dirm_row": True,
+                            "batched_counter_adds_ms": 0.2,
+                            "llc_meta_128pad_vs_transposed_ms": 0.35,
+                        },
+                        "rejected_measured_slower": {
+                            "windowed_dynamic_col_gathers_ms": 5.6,
+                            "chained_scatter_same_array_ms": 5.0,
+                            "phase1_prefetch_reuse_selects": 0.9,
+                            "scan_unroll2_gain_ms": 0.14,
+                        },
+                        "sweeps": {"rl": [4, 8, 12, 16], "rl_best": 8,
+                                   "chunk": [128, 256, 512, 1024],
+                                   "chunk_best": 512},
+                    },
                 },
             }
         )

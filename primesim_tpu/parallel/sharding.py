@@ -56,8 +56,7 @@ def state_pspecs() -> MachineState:
         cycles=P(AXIS),
         ptr=P(AXIS),
         l1=P(AXIS),
-        llc_meta=P(AXIS),
-        sharers=P(AXIS),
+        dirm=P(AXIS),
         # link/lock/barrier tables are small and written from arbitrary
         # cores' lanes — replicate them (XLA reduces the scatters across
         # devices)

@@ -25,7 +25,8 @@ from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES
 from .state import MachineState
 
-_FORMAT = 2  # v2: fused llc_meta replaces llc_tag/llc_owner; 2D llc_lru
+_FORMAT = 3  # v3: fused dirm row (metadata + sharers) replaces
+# llc_meta/sharers; 5-plane l1; link_free/dram_free queue clocks
 
 
 def trace_fingerprint(trace) -> str:

@@ -46,7 +46,7 @@ from ..trace.format import (
     EV_UNLOCK,
     Trace,
 )
-from .state import E, I, M, MachineState, S, init_state
+from .state import E, I, M, MachineState, S, init_state, llc_meta_width
 
 INT32_MAX = np.int32(2**31 - 1)
 _ACC_BITS = 30  # device counter accumulators carry into hi above 2^30
@@ -122,7 +122,8 @@ def _path_links(cfg: MachineConfig, a, b):
     )
 
 
-def _l1_probe(cfg: MachineConfig, arange_c, l1, llc_meta, sharers, line):
+def _l1_probe(cfg: MachineConfig, arange_c, l1, dirm, line,
+              run_patch=None, step_no=None):
     """Gather the accessed L1 set and derive each way's EFFECTIVE MESI state.
 
     PULL-BASED COHERENCE (the TPU-native shape of MESI): remote
@@ -172,18 +173,30 @@ def _l1_probe(cfg: MachineConfig, arange_c, l1, llc_meta, sharers, line):
     lru_rows = rows[:, 2 * W1 : 3 * W1]
     ptr_rows = rows[:, 3 * W1 : 4 * W1]
     eph_rows = rows[:, 4 * W1 :] if cfg.sharer_group > 1 else None
+    if run_patch is not None:
+        # the local run's deferred L1 writes (applied only in phase 4.A's
+        # fused scatter) patched in-register: silent E->M at wm columns,
+        # LRU stamps at hm columns (tag/ptr/epoch planes never change
+        # during a run)
+        hm, wm, cm = run_patch
+        colmatch = cm[:, :, None] == w1cols[:, None, :]  # [C, rl, W1]
+        state_rows = jnp.where(
+            jnp.any(wm[:, :, None] & colmatch, axis=1), M, state_rows
+        )
+        lru_rows = jnp.where(
+            jnp.any(hm[:, :, None] & colmatch, axis=1), step_no, lru_rows
+        )
     weff = _validate_ways(
-        cfg, arange_c, tag_rows, state_rows, ptr_rows, eph_rows, llc_meta,
-        sharers,
+        cfg, arange_c, tag_rows, state_rows, ptr_rows, eph_rows, dirm,
     )
     return w1cols, tag_rows, lru_rows, weff
 
 
 def _validate_ways(cfg, arange_c, tag_rows, state_rows, ptr_rows, eph_rows,
-                   llc_meta, sharers):
+                   dirm):
     """Pull-validate each way's locally-written state against the
     directory entry its fill-time way pointer names (see `_l1_probe`):
-    two llc_meta element gathers + one sharer-word gather, all [C, W1].
+    two tag/owner element gathers + one sharer-word gather, all [C, W1].
 
     Under the coarse sharer vector (sharer_group > 1) the core checks
     its GROUP's bit, which may stay set on a NEIGHBOR's behalf after
@@ -200,12 +213,13 @@ def _validate_ways(cfg, arange_c, tag_rows, state_rows, ptr_rows, eph_rows,
     g_c = arange_c >> logG
     pway = ptr_rows % W2  # ptr = (bank*S2 + set)*W2 + way
     pslot = ptr_rows // W2
-    vtag = llc_meta[pslot, 2 * pway]  # [C, W1]
-    vown = llc_meta[pslot, 2 * pway + 1]
-    vsh = sharers[pslot, pway * NW + (g_c[:, None] >> 5)]
-    vbit = ((vsh >> (g_c[:, None] & 31).astype(jnp.uint32)) & 1) != 0
+    MW = llc_meta_width(cfg)
+    vtag = dirm[pslot, 2 * pway]  # [C, W1]
+    vown = dirm[pslot, 2 * pway + 1]
+    vsh = dirm[pslot, MW + pway * NW + (g_c[:, None] >> 5)]
+    vbit = ((vsh >> (g_c[:, None] & 31)) & 1) != 0
     if cfg.sharer_group > 1:
-        veph = llc_meta[pslot, 3 * W2 + pway]
+        veph = dirm[pslot, 3 * W2 + pway]
         vbit = vbit & (veph == eph_rows)
     return jnp.where(
         (state_rows == I) | (vtag != tag_rows),
@@ -229,6 +243,7 @@ def step(
     S1, W1 = cfg.l1.sets, cfg.l1.ways
     S2, W2 = cfg.llc.sets, cfg.llc.ways
     NW = cfg.n_sharer_words
+    MW = llc_meta_width(cfg)  # sharer words start here in a dirm row
     Q = cfg.quantum
     T = events.shape[1]
     n_tiles = cfg.n_tiles
@@ -335,7 +350,7 @@ def step(
         pbank = pline & (B - 1)
         pbset = (pline >> logB) & (S2 - 1)
         pslot = pbank * S2 + pbset
-        pmrows = st.llc_meta[pslot]  # [C, rl+1, MW]
+        pmrows = st.dirm[pslot]  # [C, rl+1, DW] — metadata AND sharers
         pmeta = pmrows[:, :, : 2 * W2].reshape(C, rl + 1, W2, 2)
         pmmatch = pmeta[..., 0] == pline[:, :, None]
         pmhas = jnp.any(pmmatch, axis=2)
@@ -344,8 +359,13 @@ def step(
             :, :, 0
         ]
         g_c0 = arange_c >> (cfg.sharer_group.bit_length() - 1)
-        pshw = st.sharers[pslot, pmway * NW + (g_c0[:, None] >> 5)]
-        pbit = ((pshw >> (g_c0[:, None] & 31).astype(jnp.uint32)) & 1) != 0
+        # the self sharer word rides the row gather: in-register select
+        pshw = jnp.take_along_axis(
+            pmrows[:, :, MW:],
+            (pmway * NW + (g_c0[:, None] >> 5))[:, :, None],
+            axis=2,
+        )[:, :, 0]
+        pbit = ((pshw >> (g_c0[:, None] & 31)) & 1) != 0
         pmatch_l = (ptagr == pline[:, :, None]) & (pstater != I)
         plhit = jnp.any(pmatch_l, axis=2)
         plway = jnp.argmax(pmatch_l, axis=2).astype(jnp.int32)
@@ -430,28 +450,11 @@ def step(
         hm = hit_k & retire_k  # [C, rl]
         wm = w_hit_k & retire_k
         cm = phitcol[:, :rl]
-        # one scatter covers both deferred planes: LRU refreshes at
-        # plane 2, silent E->M at plane 1 (distinct planes, so no
-        # duplicate targets even when the same way takes both)
-        l1_c = l1_c.at[
-            jnp.concatenate(
-                [
-                    jnp.where(hm, arange_c[:, None], C),
-                    jnp.where(wm, arange_c[:, None], C),
-                ],
-                axis=1,
-            ),
-            jnp.concatenate([cm + 2 * FS, cm + FS], axis=1),
-        ].set(
-            jnp.concatenate(
-                [
-                    jnp.broadcast_to(step_no, (C, rl)),
-                    jnp.full((C, rl), M, jnp.int32),
-                ],
-                axis=1,
-            ),
-            mode="drop",
-        )
+        # The run's L1 writes (LRU refreshes, silent E->M) are DEFERRED
+        # all the way into phase 4.A's single fused scatter: a second
+        # scatter chained on the same array cannot alias its operand and
+        # re-materializes it (the 5 ms/step join-lru lesson). Phase 1
+        # patches the prefetched planes in-register instead.
 
     # ---- phase 0.9 + phase 1: the arbitration event and its L1 probe -----
     # addresses arrive LINE-granular (Trace.line_events normalizes byte
@@ -473,7 +476,9 @@ def step(
     line = eaddr
     l1s = line & (S1 - 1)
     w1cols, tag_rows, lru_rows, weff = _l1_probe(
-        cfg, arange_c, l1_c, st.llc_meta, st.sharers, line,
+        cfg, arange_c, l1_c, st.dirm, line,
+        run_patch=(hm, wm, cm) if rl else None,
+        step_no=step_no,
     )
     l1_match = (tag_rows == line[:, None]) & (weff != I)
     hit_any = jnp.any(l1_match, axis=1)
@@ -505,7 +510,7 @@ def step(
     bank = line & (B - 1)
     bset = (line >> logB) & (S2 - 1)
     slot = bank * S2 + bset  # [C], exact (bank,set) id
-    meta_rows = st.llc_meta[slot]  # [C, MW]
+    meta_rows = st.dirm[slot]  # [C, DW]: the set's metadata AND sharers
     mr2 = meta_rows[:, : 2 * W2].reshape(C, W2, 2)
     llc_tag_rows = mr2[..., 0]  # [C, W2]
     owner_rows = mr2[..., 1]
@@ -513,8 +518,8 @@ def step(
     llc_has = jnp.any(llc_match, axis=1)
     llc_hway = jnp.argmax(llc_match, axis=1).astype(jnp.int32)
     owner = owner_rows[arange_c, llc_hway]  # [C]
-    # one contiguous row gather serves hit way, victim way, and join path
-    sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]
+    # the sharer words came along in the same row gather
+    sh_rows = meta_rows[:, MW:].reshape(C, W2, NW)  # [C, W2, NW]
     shw = jnp.take_along_axis(sh_rows, llc_hway[:, None, None], axis=1)[:, 0]
 
     # sharer-set predicates from the PACKED words — popcount minus the
@@ -525,10 +530,10 @@ def step(
     logG = cfg.sharer_group.bit_length() - 1
     g_c = arange_c >> logG
     word_idx = g_c // 32  # [C] self -> sharer word
-    bit_idx = (g_c % 32).astype(jnp.uint32)
+    bit_idx = g_c % 32
 
-    def unpack_bits(words):  # [C, NW] uint32 -> [C, C] bool per TARGET core
-        b = (words[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]) & 1
+    def unpack_bits(words):  # [C, NW] words -> [C, C] bool per TARGET core
+        b = (words[:, :, None] >> jnp.arange(32, dtype=jnp.int32)[None, None, :]) & 1
         groups = b.reshape(C, NW * 32) != 0
         # target core t is recorded iff its GROUP's bit is set (identity
         # expansion at G=1)
@@ -696,7 +701,7 @@ def step(
         memb = jnp.asarray(memb_n)
         max2lat = jnp.asarray(max2lat_n)
         sum2hops = jnp.asarray(sum2hops_n)
-        bit5 = jnp.arange(32, dtype=jnp.uint32)
+        bit5 = jnp.arange(32, dtype=jnp.int32)
 
         def _group_bools(words):  # [C, NW] -> [C, n_grp]
             b = (words[:, :, None] >> bit5[None, None, :]) & 1
@@ -756,7 +761,7 @@ def step(
     elif cfg.sharer_chunk_words:
         K = cfg.sharer_chunk_words
         nblk = NW // K
-        bit5 = jnp.arange(32, dtype=jnp.uint32)
+        bit5 = jnp.arange(32, dtype=jnp.int32)
 
         def _blk(carry, b):
             il, ic, ih, bc, bh = carry
@@ -1100,51 +1105,75 @@ def step(
     eph_rows2 = meta_rows[:, 3 * W2 : 4 * W2]  # [C, W2]
     eph_way = jnp.where(join, llc_hway, llc_uway)
     new_eph = eph_rows2[arange_c, eph_way] + takes_own.astype(jnp.int32)
-    # ALL SEVEN L1 writes in ONE scatter on the fused plane array (per-
-    # kernel overhead dominates; see the counters note). Targets are
-    # pairwise distinct: dup_col != upd_col (a duplicate is a different
-    # way than the fill target), hit refresh and grant rows are disjoint
-    # lane classes, and each write addresses its own plane.
-    l1_n = l1_c.at[
-        jnp.stack(
-            [dup_row, dup_row, lru_row, st_row, wj_row, wj_row, wj_row],
-            axis=1,
-        ),
-        jnp.stack(
+    # ALL of this step's L1 writes — the seven phase-4 columns AND the
+    # local run's deferred LRU/E->M writes — in ONE scatter on the fused
+    # plane array (per-kernel overhead dominates, and a second scatter
+    # chained on the same array cannot alias its operand). Targets are
+    # pairwise distinct up to benign identical-value duplicates:
+    # dup_col != upd_col (a duplicate is a different way than the fill
+    # target), hit refresh and grant rows are disjoint lane classes, each
+    # write addresses its own plane, run-LRU duplicates of phase-4 LRU
+    # writes carry the identical step stamp, and a run E->M colliding
+    # with a phase-4 state write at the same way is SUPPRESSED (phase 4
+    # wrote after the run in the serialized order, so its value wins).
+    l1_rows = [dup_row, dup_row, lru_row, st_row, wj_row, wj_row, wj_row]
+    l1_cols = [
+        dup_col,  # stale duplicate tag clear
+        dup_col + FS,  # stale duplicate state clear
+        lru_col + 2 * FS,  # hit refresh / fill LRU stamp
+        st_col + FS,  # silent E->M + grant state
+        upd_col,  # fill tag
+        upd_col + 3 * FS,  # fill way pointer
+        upd_col + 4 * FS,  # fill-time entry epoch (post-bump)
+    ]
+    l1_vals = [
+        jnp.full(C, -1, jnp.int32),
+        jnp.full(C, I, jnp.int32),
+        jnp.broadcast_to(step_no, (C,)),
+        st_val,
+        line,
+        fill_ptr,
+        new_eph,
+    ]
+    rows_mat = jnp.stack(l1_rows, axis=1)
+    cols_mat = jnp.stack(l1_cols, axis=1)
+    vals_mat = jnp.stack(l1_vals, axis=1)
+    if rl:
+        own_state_write = (st_row == arange_c)
+        run_m_sup = wm & ~(own_state_write[:, None] & (st_col[:, None] == cm))
+        rows_mat = jnp.concatenate(
             [
-                dup_col,  # stale duplicate tag clear
-                dup_col + FS,  # stale duplicate state clear
-                lru_col + 2 * FS,  # hit refresh / fill LRU stamp
-                st_col + FS,  # silent E->M + grant state
-                upd_col,  # fill tag
-                upd_col + 3 * FS,  # fill way pointer
-                upd_col + 4 * FS,  # fill-time entry epoch (post-bump)
+                rows_mat,
+                jnp.where(hm, arange_c[:, None], C),
+                jnp.where(run_m_sup, arange_c[:, None], C),
             ],
             axis=1,
-        ),
-    ].set(
-        jnp.stack(
+        )
+        cols_mat = jnp.concatenate(
+            [cols_mat, cm + 2 * FS, cm + FS], axis=1
+        )
+        vals_mat = jnp.concatenate(
             [
-                jnp.full(C, -1, jnp.int32),
-                jnp.full(C, I, jnp.int32),
-                jnp.broadcast_to(step_no, (C,)),
-                st_val,
-                line,
-                fill_ptr,
-                new_eph,
+                vals_mat,
+                jnp.broadcast_to(step_no, (C, rl)),
+                jnp.full((C, rl), M, jnp.int32),
             ],
             axis=1,
-        ),
-        mode="drop",
-    )
+        )
+    l1_n = l1_c.at[rows_mat, cols_mat].set(vals_mat, mode="drop")
 
-    # LLC entry update: ONE full-row scatter writes each winner's whole
-    # tag/owner/LRU metadata row back (collision-free: one winner per
-    # (bank,set); non-winning lanes scatter to the dropped row B*S2) —
-    # the round-4 profile billed ~0.28 ms/step to the three narrow
-    # scatters this replaces. Join LRU refreshes land in a second,
-    # element-wide scatter: join slots never have a winner, so the rows
-    # are disjoint, and same-slot joiners write the identical step stamp.
+    # Directory update: ONE full-row scatter-ADD covers the winner's
+    # whole row — tags, owner, LRU, epoch, AND sharer words — plus every
+    # join's sharer bit (winner and join slots are disjoint: join slots
+    # never have a winner). Winner rows carry the exact full-row delta
+    # (new - old; exactly one winner per slot, so old + delta == new,
+    # wrap-safe in int32); join rows contribute only the joiner's own
+    # bit, masked against the step-start word (self_word & ~shw) so a
+    # silently-evicted re-joiner's stale bit cannot carry into the
+    # adjacent bit — golden's _set_sharer is idempotent, the masked add
+    # matches it; multiple joiners per slot add distinct bits. Join LRU
+    # refreshes land in a second element scatter (same-slot joiners write
+    # the identical step stamp).
     new_owner = jnp.where(takes_own, arange_c, -1)
     wayeq = jnp.arange(W2, dtype=jnp.int32)[None, :] == llc_uway[:, None]
     new_meta = jnp.concatenate(
@@ -1158,20 +1187,14 @@ def step(
             ).reshape(C, 2 * W2),
             jnp.where(wayeq, step_no, llc_lru_rows),
             jnp.where(wayeq, new_eph[:, None], eph_rows2),
-            jnp.zeros((C, st.llc_meta.shape[1] - 4 * W2), jnp.int32),
+            jnp.zeros((C, MW - 4 * W2), jnp.int32),
         ],
         axis=1,
-    )
-    wslot = jnp.where(winner, slot, B * S2)
-    llc_meta_n = st.llc_meta.at[wslot].set(new_meta, mode="drop")
-    jslot = jnp.where(join, slot, B * S2)
-    llc_meta_n = llc_meta_n.at[jslot, 2 * W2 + llc_hway].set(
-        step_no, mode="drop"
     )
 
     # new sharer words [C, NW]
     self_word = (
-        (jnp.arange(NW)[None, :] == word_idx[:, None]).astype(jnp.uint32)
+        (jnp.arange(NW)[None, :] == word_idx[:, None]).astype(jnp.int32)
         << bit_idx[:, None]
     )  # bit(c) as packed words
     # the probed owner is re-recorded as a sharer unconditionally: the home
@@ -1181,8 +1204,8 @@ def step(
     og_bit = oclamp >> logG  # owner's sharer-GROUP bit (identity at G=1)
     owner_word = jnp.where(
         jnp.arange(NW)[None, :] == (og_bit // 32)[:, None],
-        jnp.uint32(1) << (og_bit % 32).astype(jnp.uint32)[:, None],
-        jnp.uint32(0),
+        jnp.int32(1) << (og_bit % 32)[:, None],
+        0,
     )
     new_shw = jnp.where(
         gets_probe[:, None],
@@ -1193,22 +1216,11 @@ def step(
             jnp.zeros_like(shw),  # M grants, E grants, misses: cleared
         ),
     )
-    # ONE combined scatter-add updates winner AND join rows (they are
-    # disjoint: join slots never have a winner). Winner rows contribute the
-    # full-row delta (new_row - old_row; exactly one winner per slot, so
-    # old + delta == new, wrap-safe in uint32). Join rows contribute only
-    # the joiner's own bit, masked against the step-start word
-    # (self_word & ~shw): a silently-evicted sharer that re-joins still has
-    # its stale bit recorded, and an unmasked add would carry into the
-    # adjacent bit — golden's _set_sharer is idempotent, so the masked add
-    # matches it. Multiple joiners per slot add distinct bits. A single
-    # scatter traverses the (huge) sharers array's update path once, not
-    # twice.
     way_seg = (
         jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_uway[:, None]
     )
     old_flat = sh_rows.reshape(C, W2 * NW)
-    new_row = jnp.where(
+    new_sh_row = jnp.where(
         way_seg,
         jnp.broadcast_to(new_shw[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
         old_flat,
@@ -1217,16 +1229,42 @@ def step(
         jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_hway[:, None]
     )
     join_word = self_word & ~shw  # carry-free when the bit is already set
-    join_row = jnp.where(
+    join_sh_row = jnp.where(
         join_seg,
         jnp.broadcast_to(join_word[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
-        jnp.uint32(0),
+        0,
     )
+    # Join LRU refreshes ride the SAME scatter-add: adds only commute for
+    # identical targets if exactly one lane carries the delta, so a
+    # per-(slot, way) scatter-min on the (small, 16 MB) representative
+    # table picks one joiner per joined way to add (step_no - old_lru);
+    # same-way co-joiners add zero. A second element scatter chained
+    # after the row-add was measured at ~5 ms/step (prof_bisect r5: any
+    # read-modify-write scatter that cannot alias re-materializes the
+    # 800 MB operand), so everything must go through the ONE add.
+    jsw = jnp.where(join, slot * W2 + llc_hway, B * S2 * W2)
+    jtab = jnp.full(B * S2 * W2, INT32_MAX, jnp.int32).at[jsw].min(
+        key, mode="drop"
+    )
+    jrep = join & (
+        jtab[jnp.minimum(slot * W2 + llc_hway, B * S2 * W2 - 1)] == key
+    )
+    old_lru_h = meta_rows[arange_c, 2 * W2 + llc_hway]
+    lru_oh = (
+        jnp.arange(MW, dtype=jnp.int32)[None, :]
+        == (2 * W2 + llc_hway)[:, None]
+    )
+    join_meta = jnp.where(
+        lru_oh, jnp.where(jrep, step_no - old_lru_h, 0)[:, None], 0
+    )
+    new_full = jnp.concatenate([new_meta, new_sh_row], axis=1)  # [C, DW]
     delta_row = jnp.where(
-        winner[:, None], new_row - old_flat, join_row
+        winner[:, None],
+        new_full - meta_rows,
+        jnp.concatenate([join_meta, join_sh_row], axis=1),
     )
     upd_slot = jnp.where(winner | join, slot, B * S2)
-    sharers_n = st.sharers.at[upd_slot].add(delta_row, mode="drop")
+    dirm_n = st.dirm.at[upd_slot].add(delta_row, mode="drop")
 
     # No phase 4.B: under pull-based coherence, the directory updates above
     # ARE the invalidations/downgrades — remote L1s re-derive their state on
@@ -1354,8 +1392,7 @@ def step(
         cycles=cycles,
         ptr=ptr,
         l1=l1_n,
-        llc_meta=llc_meta_n,
-        sharers=sharers_n,
+        dirm=dirm_n,
         link_free=link_free_n,
         dram_free=dram_free_n,
         lock_holder=lock_holder,

@@ -23,10 +23,17 @@ I, S, E, M = 0, 1, 2, 3
 
 
 def llc_meta_width(cfg: MachineConfig) -> int:
-    """Padded llc_meta row width: 4*W2 data columns (tag/owner pairs,
-    lru, invalidation epoch) rounded up to a 128-lane multiple so the
-    array tiles row-major (see field note)."""
+    """Width of the metadata prefix of a `dirm` row: 4*W2 data columns
+    (tag/owner pairs, lru, invalidation epoch) rounded up to a 128-lane
+    multiple so both the prefix and the sharer words that follow stay
+    lane-aligned (see field note)."""
     return ((4 * cfg.llc.ways + 127) // 128) * 128
+
+
+def dirm_width(cfg: MachineConfig) -> int:
+    """Full `dirm` row width: metadata prefix + W2*NW packed sharer
+    words."""
+    return llc_meta_width(cfg) + cfg.llc.ways * cfg.n_sharer_words
 
 
 class MachineState(NamedTuple):
@@ -49,36 +56,32 @@ class MachineState(NamedTuple):
     # (>= 2560) so tiling stays natural; a 3D shape would make XLA pad
     # the tiny way dim to 128.
     l1: jnp.ndarray  # [C, 5*W1*S1] int32
-    # LLC banks + directory metadata, fused: ROW PER (bank, set) — row
-    # slot = bank*S2 + set, columns [2w]=tag, [2w+1]=owner, [2*W2+w]=lru,
-    # [3*W2+w]=invalidation epoch (bumped on every sharer-CLEARING
-    # transition; the coarse sharer vector's pull-validation compares it
-    # against the L1's fill-time record so a neighbor's later re-share
-    # cannot resurrect an invalidated entry), rest zero padding up to
-    # `llc_meta_width` (a 128 multiple). One
-    # FULL-ROW gather (`llc_meta[slot]`, same addressing as the sharers
-    # array) returns the accessed set's tags+owners+LRU stamps in a
-    # single op, and the winner transition writes them back in a single
-    # full-row scatter. Full-row forms are the ones XLA lowers well on
-    # TPU: the round-5 profile showed whole-row gather/scatter at ~0.02-
-    # 0.1 ms while windowed (dynamic column offset) forms cost 2-4 ms and
-    # three narrow [B,S2,W2] scatters cost 0.28 ms. The EXPLICIT pad to a
-    # 128-lane minor dim matters as much as the form: at 3*W2 (=24)
-    # columns XLA's layout assignment flips the array to a
-    # dim0-minor physical layout (transposing beats 5x pad in its cost
-    # model), which turns every logical row into a strided walk across
-    # tiles — the compiled HLO showed {0,1:T(8,128)} and the phase
-    # profile billed ~2 ms/step to meta traffic until the pad forced the
-    # natural row-major tiling back.
-    llc_meta: jnp.ndarray  # [B*S2, llc_meta_width(cfg)] int32
-    # Directory sharer bit-vectors, stored row-per-(bank,set) with the way
-    # axis folded into columns: row slot b*S2+s, columns [w*NW, (w+1)*NW).
-    # Kept 2D so XLA settles on ONE layout for it — the natural
-    # [B,S2,W2,NW] shape made layout assignment bounce this (huge, at large
-    # core counts) array between gather- and loop-carry-preferred layouts,
-    # costing two full copies per step. (At the 1024-core flagship config
-    # the minor dim is also a 128 multiple, which tiles without padding.)
-    sharers: jnp.ndarray  # [B*S2, W2*NW] uint32 packed sharer bits
+    # The WHOLE directory, fused: ROW PER (bank, set) — row slot =
+    # bank*S2 + set. Columns:
+    #   [2w]            = way w's tag (-1 invalid)
+    #   [2w+1]          = way w's owner (-1 none)
+    #   [2*W2 + w]      = way w's LRU step-stamp
+    #   [3*W2 + w]      = way w's invalidation epoch (bumped on every
+    #                     sharer-CLEARING transition; the coarse sharer
+    #                     vector's pull-validation compares it against
+    #                     the L1's fill-time record so a neighbor's later
+    #                     re-share cannot resurrect an invalidated entry)
+    #   [4*W2 .. MW)    = zero pad up to llc_meta_width (128 multiple)
+    #   [MW + w*NW + i] = way w's packed sharer bit-vector word i
+    # ONE full-row gather returns EVERYTHING the step needs about the
+    # accessed set — tags, owners, LRU, epochs, sharer words — and the
+    # winner/join transition writes back through ONE row scatter-add
+    # (winner rows carry exact full-row deltas; join rows just their own
+    # sharer bit). Per-step cost on this TPU path is per-KERNEL overhead,
+    # so collapsing the former sharers+meta arrays' separate gathers/
+    # scatters is the win. Full-row forms are the ones XLA lowers well
+    # (windowed dynamic-column forms cost 2-4 ms); the explicit 128-lane
+    # alignment of the prefix stops XLA's layout assignment from flipping
+    # the array to a dim0-minor (transposed) physical layout, which turns
+    # every logical row into a strided walk across tiles. int32
+    # throughout: sharer bit arithmetic (shift+mask extraction, popcount,
+    # wrapping add-deltas) is representation-identical to uint32.
+    dirm: jnp.ndarray  # [B*S2, dirm_width(cfg)] int32
     # hop-by-hop router (contention_model="router"): per-directed-link
     # next-free clock, epoch-relative, carried across steps; rebased with
     # the core clocks (clamped at -(1<<30) — a clock that far in the past
@@ -120,16 +123,15 @@ def init_state(cfg: MachineConfig) -> MachineState:
             ],
             axis=1,
         ),
-        llc_meta=jnp.concatenate(
+        dirm=jnp.concatenate(
             [
                 jnp.full((B * s2, 2 * w2), -1, jnp.int32),  # tag/owner
                 jnp.zeros(
-                    (B * s2, llc_meta_width(cfg) - 2 * w2), jnp.int32
-                ),  # lru stamps + tiling pad
+                    (B * s2, dirm_width(cfg) - 2 * w2), jnp.int32
+                ),  # lru + epochs + pad + sharer words
             ],
             axis=1,
         ),
-        sharers=jnp.zeros((B * s2, w2 * nw), jnp.uint32),
         link_free=jnp.zeros(cfg.n_tiles * 4, jnp.int32),
         dram_free=jnp.zeros(B, jnp.int32),
         lock_holder=jnp.full(cfg.lock_slots, -1, jnp.int32),

@@ -14,7 +14,13 @@ from __future__ import annotations
 import numpy as np
 
 from ..config.machine import MachineConfig
-from .state import E, I, M, S  # noqa: F401  (shared MESI encoding)
+from .state import (  # noqa: F401  (shared MESI encoding)
+    E,
+    I,
+    M,
+    S,
+    llc_meta_width,
+)
 
 
 def engine_l1_to_golden(cfg: MachineConfig, arr: np.ndarray) -> np.ndarray:
@@ -47,23 +53,31 @@ def epoch_views(cfg: MachineConfig, state):
     FS = cfg.l1.ways * cfg.l1.sets
     W2, S2, B = cfg.llc.ways, cfg.llc.sets, cfg.n_banks
     l1_eph = np.asarray(state.l1)[:, 4 * FS : 5 * FS]
-    llc_eph = np.asarray(state.llc_meta)[:, 3 * W2 : 4 * W2].reshape(
+    llc_eph = np.asarray(state.dirm)[:, 3 * W2 : 4 * W2].reshape(
         B, S2, W2
     )
     return l1_eph, llc_eph
+
+
+def sharers_view(cfg: MachineConfig, state):
+    """The packed sharer words [B*S2, W2*NW] from the fused `dirm` rows,
+    reinterpreted as uint32 (engine stores them as int32 bit patterns;
+    the golden model uses uint32)."""
+    MW = llc_meta_width(cfg)
+    return np.asarray(state.dirm)[:, MW:].view(np.uint32)
 
 
 def llc_views(cfg: MachineConfig, state):
     """Unpack the engine's fused LLC metadata into golden-layout views.
 
     The engine stores the whole per-(bank,set) LLC metadata in one
-    `llc_meta` row (row slot = bank*S2 + set; columns [2w]=tag,
+    `dirm` row (row slot = bank*S2 + set; columns [2w]=tag,
     [2w+1]=owner, [2*W2+w]=lru); returns (llc_tag, llc_owner, llc_lru)
     as [B, S2, W2] NumPy arrays, the golden model's layout.
     """
     B = cfg.n_banks
     S2, W2 = cfg.llc.sets, cfg.llc.ways
-    meta = np.asarray(state.llc_meta)
+    meta = np.asarray(state.dirm)
     pairs = meta[:, : 2 * W2].reshape(B, S2, W2, 2)
     lru = meta[:, 2 * W2 : 3 * W2].reshape(B, S2, W2)
     return pairs[..., 0], pairs[..., 1], lru
@@ -152,7 +166,7 @@ def check_invariants(cfg: MachineConfig, state, done_mask=None) -> None:
     C = cfg.n_cores
     l1_tag, l1_state, _, _ = l1_views(cfg, state)
     llc_tag, llc_owner, _ = llc_views(cfg, state)
-    sharers = np.asarray(state.sharers)
+    sharers = sharers_view(cfg, state)
     B, S2, W2 = llc_tag.shape
     NW = cfg.n_sharer_words
 

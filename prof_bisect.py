@@ -24,17 +24,13 @@ SRC = open(eng_mod.__file__).read()
 
 VARIANTS = {
     "full": [],
-    "no_sharers_scatter": [
-        ('sharers_n = st.sharers.at[upd_slot].add(delta_row, mode="drop")',
-         "sharers_n = st.sharers"),
+    "no_dirm_scatter": [
+        ('    dirm_n = st.dirm.at[upd_slot].add(delta_row, mode="drop")',
+         "    dirm_n = st.dirm"),
     ],
-    "no_meta_scatter": [
-        ('    llc_meta_n = st.llc_meta.at[wslot].set(new_meta, mode="drop")',
-         "    llc_meta_n = st.llc_meta"),
-    ],
-    "no_joinlru_scatter": [
-        ("    llc_meta_n = llc_meta_n.at[jslot, 2 * W2 + llc_hway].set(\n        step_no, mode=\"drop\"\n    )",
-         "    llc_meta_n = llc_meta_n"),
+    "no_joinrep_table": [
+        ('    jtab = jnp.full(B * S2 * W2, INT32_MAX, jnp.int32).at[jsw].min(\n        key, mode="drop"\n    )',
+         "    jtab = jnp.full(B * S2 * W2, INT32_MAX, jnp.int32)"),
     ],
     "no_unpack_CC": [
         ("        sh_bits = unpack_bits(shw)",
@@ -51,36 +47,25 @@ VARIANTS = {
     "no_l1_scatter": [
         ("    l1_n = l1_c.at[", "    l1_n = l1_c; _dead = l1_c.at["),
     ],
-    "no_run_l1_scatter": [
-        ("        l1_c = l1_c.at[", "        _deadrun = l1_c.at["),
-    ],
     "no_ptr_gathers": [
-        ("    vtag = llc_meta[pslot, 2 * pway]  # [C, W1]",
+        ("    vtag = dirm[pslot, 2 * pway]  # [C, W1]",
          "    vtag = tag_rows"),
-        ("    vown = llc_meta[pslot, 2 * pway + 1]",
+        ("    vown = dirm[pslot, 2 * pway + 1]",
          "    vown = jnp.broadcast_to(arange_c[:, None], tag_rows.shape)"),
-        ("    vsh = sharers[pslot, pway * NW + (g_c[:, None] >> 5)]",
-         "    vsh = jnp.zeros(tag_rows.shape, jnp.uint32)"),
+        ("    vsh = dirm[pslot, MW + pway * NW + (g_c[:, None] >> 5)]",
+         "    vsh = jnp.zeros(tag_rows.shape, jnp.int32)"),
     ],
     "no_phase1_validation": [
         ("    return jnp.where(\n        (state_rows == I) | (vtag != tag_rows),\n        I,\n        jnp.where(\n            vown == arange_c[:, None],\n            state_rows,\n            jnp.where(vbit, S, I),\n        ),\n    )  # [C, W1] effective MESI per way",
          "    return state_rows"),
     ],
-    "no_metarows_gather": [
-        ("    meta_rows = st.llc_meta[slot]  # [C, MW]",
-         "    meta_rows = jnp.full((C, st.llc_meta.shape[1]), -1, jnp.int32)"),
+    "no_dirmrows_gather": [
+        ("    meta_rows = st.dirm[slot]  # [C, DW]: the set\'s metadata AND sharers",
+         "    meta_rows = jnp.full((C, st.dirm.shape[1]), -1, jnp.int32)"),
     ],
-    "no_shrows_gather": [
-        ("    sh_rows = st.sharers[slot].reshape(C, W2, NW)  # [C, W2, NW]",
-         "    sh_rows = jnp.zeros((C, W2, NW), jnp.uint32)"),
-    ],
-    "no_run_prefetch_meta": [
-        ("        pmrows = st.llc_meta[pslot]  # [C, rl+1, MW]",
-         "        pmrows = jnp.full((C, rl + 1, st.llc_meta.shape[1]), -1, jnp.int32)"),
-    ],
-    "no_run_prefetch_shw": [
-        ("        pshw = st.sharers[pslot, pmway * NW + (g_c0[:, None] >> 5)]",
-         "        pshw = jnp.zeros((C, rl + 1), jnp.uint32)"),
+    "no_run_prefetch_rows": [
+        ("        pmrows = st.dirm[pslot]  # [C, rl+1, DW] — metadata AND sharers",
+         "        pmrows = jnp.full((C, rl + 1, st.dirm.shape[1]), -1, jnp.int32)"),
     ],
 }
 

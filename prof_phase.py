@@ -26,10 +26,9 @@ SRC = open(eng_mod.__file__).read()
 CUTS = [
     ("p0_quantum", "# ---- phase 0.5", "quantum_end"),
     ("p05_localrun", "# ---- phase 0.9", "cycles_c + ptr_c"),
-    ("p09_arbevent", "# ---- phase 1:", "cycles_c + ptr_c + et + eaddr"),
-    ("p1_probe", "# LLC lookup for the accessed line",
-     "cycles_c + ptr_c + weff.sum(1) + hit_way + et"),
-    ("p1_llcrows", "# ---- phase 2:",
+    ("p09_arbevent", "# LLC lookup for the accessed line",
+     "cycles_c + ptr_c + et + eaddr + weff.sum(1) + hit_way"),
+        ("p1_llcrows", "# ---- phase 2:",
      "cycles_c + ptr_c + weff.sum(1) + llc_hway + owner + self_bit + et"),
     ("p2_arb", "# ---- phase 3:",
      "cycles_c + ptr_c + weff.sum(1) + owner + winner + join + retry + et"),
@@ -40,7 +39,7 @@ CUTS = [
      "cycles_c + ptr_c + weff.sum(1) + winner + lat + lat_join + et"),
     ("p4_counters", "# ---- phase 4.A",
      "cycles_c + ptr_c + weff.sum(1) + winner + lat + noc_msgs + et"),
-    ("p4a_l1", "# LLC entry update",
+    ("p4a_l1", "# Directory update:",
      "cycles + ptr + l1_n.sum(1) + lat"),
     ("full", None, None),
 ]

@@ -76,24 +76,28 @@ def test_checker_detects_violations():
     eng.run()
     check_invariants(cfg, eng.state)  # clean state passes
 
-    # owned entry with sharers recorded (fused llc_meta layout: column
-    # (set*W2 + way)*2 holds the tag, +1 the owner — (bank 0, set 0,
-    # way 0) is columns 0/1)
+    # owned entry with sharers recorded (fused dirm layout: column
+    # (set*W2 + way)*2 holds the tag, +1 the owner, and the sharer words
+    # start at llc_meta_width — (bank 0, set 0, way 0) is cols 0/1 and
+    # its first sharer word MW+0)
+    from primesim_tpu.sim.state import llc_meta_width
+
+    MW = llc_meta_width(cfg)
     bad = eng.state._replace(
-        llc_meta=eng.state.llc_meta.at[0, 0].set(12345).at[0, 1].set(1),
-        sharers=eng.state.sharers.at[0, 0].set(jnp.uint32(0b11)),
+        dirm=eng.state.dirm.at[0, 0].set(12345).at[0, 1].set(1)
+        .at[0, MW].set(0b11),
     )
     with pytest.raises(AssertionError, match="sharer set"):
         check_invariants(cfg, bad)
 
     # out-of-range owner
-    bad = eng.state._replace(llc_meta=eng.state.llc_meta.at[0, 1].set(99))
+    bad = eng.state._replace(dirm=eng.state.dirm.at[0, 1].set(99))
     with pytest.raises(AssertionError, match="out of range"):
         check_invariants(cfg, bad)
 
     # duplicate valid LLC tag within a set (ways 0 and 1 -> columns 0, 2)
     bad = eng.state._replace(
-        llc_meta=eng.state.llc_meta.at[0, 0].set(777).at[0, 2].set(777)
+        dirm=eng.state.dirm.at[0, 0].set(777).at[0, 2].set(777)
     )
     with pytest.raises(AssertionError, match="duplicate valid LLC tag"):
         check_invariants(cfg, bad)
@@ -128,6 +132,7 @@ def test_em_exclusivity_is_structural():
         effective_l1_state,
         l1_views,
         llc_views,
+        sharers_view,
     )
 
     cfg = small_test_config(4)
@@ -147,7 +152,7 @@ def test_em_exclusivity_is_structural():
             .at[c, 3 * FS + l1s].set(entry_ptr)  # ptr plane
         )
     st = st._replace(
-        llc_meta=st.llc_meta.at[mrow, 0].set(line).at[mrow, 1].set(0),
+        dirm=st.dirm.at[mrow, 0].set(line).at[mrow, 1].set(0),
         l1=l1,
     )
 
@@ -156,12 +161,12 @@ def test_em_exclusivity_is_structural():
         l1_tag_v, l1_state_v, _, _ = l1_views(cfg, state)
         eff = effective_l1_state(
             cfg, l1_tag_v, l1_state_v,
-            tag_v, own_v, np.asarray(state.sharers),
+            tag_v, own_v, sharers_view(cfg, state),
         )
         return sorted(set(np.nonzero((eff >= 2).any(axis=(1, 2)))[0].tolist()))
 
     check_invariants(cfg, st)
     assert em_holders(st) == [0]  # owner 0 holds M; core 1 validates to I
-    flipped = st._replace(llc_meta=st.llc_meta.at[mrow, 1].set(1))
+    flipped = st._replace(dirm=st.dirm.at[mrow, 1].set(1))
     check_invariants(cfg, flipped)  # still consistent: ownership moved
     assert em_holders(flipped) == [1]

@@ -60,7 +60,7 @@ def test_state_is_actually_sharded():
     e = Engine(cfg, trace, mesh=mesh)
     shardings = {
         "cycles": e.state.cycles.sharding,
-        "llc_meta": e.state.llc_meta.sharding,
+        "dirm": e.state.dirm.sharding,
         "events": e.events.sharding,
     }
     for name, s in shardings.items():

@@ -17,6 +17,7 @@ from primesim_tpu.sim.validate import (
     epoch_views,
     l1_views,
     llc_views,
+    sharers_view,
 )
 from primesim_tpu.trace import synth
 
@@ -61,7 +62,7 @@ def assert_parity(cfg, trace, chunk_steps=64):
         e_l1_state2,
         e_llc_tag,
         e_llc_owner,
-        np.asarray(e.state.sharers),
+        sharers_view(cfg, e.state),
         l1_eph=e_l1_eph,
         llc_eph=e_llc_eph,
     )
@@ -75,9 +76,10 @@ def assert_parity(cfg, trace, chunk_steps=64):
     )
     np.testing.assert_array_equal(e_llc_tag, g.llc_tag, err_msg="llc_tag")
     np.testing.assert_array_equal(e_llc_owner, g.llc_owner, err_msg="llc_owner")
-    # engine stores sharers row-per-(bank,set) with ways folded into columns
+    # engine stores sharers row-per-(bank,set) with ways folded into the
+    # fused dirm rows' tail columns
     np.testing.assert_array_equal(
-        np.asarray(e.state.sharers).reshape(g.sharers.shape),
+        sharers_view(cfg, e.state).reshape(g.sharers.shape),
         g.sharers,
         err_msg="sharers",
     )
