@@ -150,9 +150,11 @@ def main() -> None:
                             "phase1_prefetch_reuse_selects": 0.9,
                             "scan_unroll2_gain_ms": 0.14,
                         },
-                        "sweeps": {"rl": [4, 8, 12, 16], "rl_best": 8,
-                                   "chunk": [128, 256, 512, 1024],
-                                   "chunk_best": 512},
+                        "sweeps_final_mips": {
+                            "rl6": 4.56, "rl8": 4.62, "rl10": 4.14,
+                            "rl12": 3.71, "chunk256": 4.65,
+                            "chunk512": 4.62, "chunk768": 4.64,
+                        },
                     },
                 },
             }

@@ -73,9 +73,41 @@ def _load_config(path: str):
         return MachineConfig.from_json(f.read())
 
 
-def cmd_run(ns) -> int:
+def _emit_summary(ns, cfg, engine_name, counters, cycles, wall, extra=None):
+    """Shared one-line JSON summary + optional text report (the single
+    emission contract for every engine path)."""
     from ..stats.report import write_report
 
+    tot_ins = int(counters["instructions"].sum())
+    detail = {
+        "engine": engine_name,
+        "n_cores": cfg.n_cores,
+        "instructions": tot_ins,
+        "max_core_cycles": int(max(cycles)),
+        "wall_s": round(wall, 3),
+        "noc_msgs": int(counters["noc_msgs"].sum()),
+    }
+    if extra:
+        detail.update(extra)
+    print(
+        json.dumps(
+            {
+                "metric": "simulated_MIPS",
+                "value": round(tot_ins / wall / 1e6, 3),
+                "unit": "MIPS",
+                "detail": detail,
+            }
+        )
+    )
+    if ns.report:
+        write_report(
+            ns.report, cfg, counters, cycles, wall_s=wall,
+            per_core_limit=ns.per_core_limit,
+        )
+        print(f"report written to {ns.report}", file=sys.stderr)
+
+
+def cmd_run(ns) -> int:
     cfg = _load_config(ns.config)
     tr = _load_trace(ns, cfg.n_cores)
     if tr.n_cores != cfg.n_cores:
@@ -179,28 +211,69 @@ def cmd_run(ns) -> int:
         wall = time.perf_counter() - t0
         cycles, counters = eng.cycles, eng.counters
 
-    tot_ins = int(counters["instructions"].sum())
-    summary = {
-        "metric": "simulated_MIPS",
-        "value": round(tot_ins / wall / 1e6, 3),
-        "unit": "MIPS",
-        "detail": {
-            "engine": ns.engine,
-            "n_cores": cfg.n_cores,
-            "instructions": tot_ins,
-            "max_core_cycles": int(max(cycles)),
-            "wall_s": round(wall, 3),
-            "noc_msgs": int(counters["noc_msgs"].sum()),
-        },
-    }
-    print(json.dumps(summary))
-    if ns.report:
-        write_report(
-            ns.report, cfg, counters, cycles, wall_s=wall,
-            per_core_limit=ns.per_core_limit,
-        )
-        print(f"report written to {ns.report}", file=sys.stderr)
+    _emit_summary(ns, cfg, ns.engine, counters, cycles, wall)
     return 0
+
+
+def cmd_capture(ns) -> int:
+    """Execution-driven simulation of a real binary (SURVEY.md §2 #9):
+    run the target under the LD_PRELOAD capture shim and either simulate
+    ONLINE while it executes (default, shared-memory ring) or write a
+    PTPU trace for later replay (--out)."""
+    cfg = _load_config(ns.config)
+    if ns.out:
+        if ns.report:
+            raise SystemExit(
+                "--report needs a simulation: drop --out for online mode, "
+                "or replay the trace with `primetpu run --trace`"
+            )
+        from ..ingest.capture import capture_run
+
+        try:
+            tr = capture_run(ns.command, line=cfg.l1.line)
+        except RuntimeError as e:
+            print(f"capture failed: {e}", file=sys.stderr)
+            return 1
+        tr.save(ns.out)
+        print(
+            f"wrote {ns.out}: {tr.n_cores} cores x {tr.max_len} events",
+            file=sys.stderr,
+        )
+        return 0
+
+    from ..ingest.capture import capture_online
+    from ..ingest.ring import OnlineEngine
+
+    proc, src = capture_online(
+        ns.command, n_cores=cfg.n_cores, line=cfg.l1.line,
+        retain_history=False,
+    )
+    try:
+        eng = OnlineEngine(cfg, src, window_events=ns.window)
+        # warm the jit cache outside the timed region — the shared
+        # measurement protocol (every MIPS this CLI prints excludes
+        # one-off compilation)
+        eng.warmup()
+        t0 = time.perf_counter()
+        eng.run()
+        wall = time.perf_counter() - t0
+        rc = proc.wait(timeout=30)
+        if rc != 0:
+            print(f"target exited {rc}", file=sys.stderr)
+        if src.dropped():
+            print(
+                f"WARNING: {src.dropped()} events dropped on full rings",
+                file=sys.stderr,
+            )
+        _emit_summary(
+            ns, cfg, "online", eng.counters, eng.cycles, wall,
+            extra={"events": int(src.total.sum()), "target_rc": rc},
+        )
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        src.close()
 
 
 def cmd_synth(ns) -> int:
@@ -268,6 +341,24 @@ def build_parser() -> argparse.ArgumentParser:
              "(cores/L1s by core, LLC/directory by bank; jax engine)",
     )
     r.set_defaults(fn=cmd_run)
+
+    c = sub.add_parser(
+        "capture",
+        help="run a pthread binary under the capture frontend and "
+             "simulate it ONLINE (or write a trace with --out)",
+    )
+    c.add_argument("config", help="machine config (.json or .xml)")
+    c.add_argument(
+        "command", nargs="+",
+        help="target command line (prefix with -- to separate flags)",
+    )
+    c.add_argument(
+        "--out", help="write a PTPU trace instead of simulating online"
+    )
+    c.add_argument("--window", type=int, default=1024)
+    c.add_argument("--report", help="write text report to this path")
+    c.add_argument("--per-core-limit", type=int, default=64)
+    c.set_defaults(fn=cmd_capture)
 
     s = sub.add_parser("synth", help="generate a synthetic PTPU trace file")
     s.add_argument("spec", help="generator spec name[:k=v,...]")

@@ -288,6 +288,27 @@ class OnlineEngine:
             exhausted[c] = done_before_drain[c] and take == avail
         return buf, exhausted, filled
 
+    def warmup(self) -> None:
+        """Compile `stream_loop` at this run's window shapes with a
+        zero-step budget and block until ready (mirrors
+        StreamEngine.warmup): callers that time `run()` must not bill
+        one-off compilation to simulation speed."""
+        import jax.numpy as jnp
+
+        C = self.cfg.n_cores
+        buf = np.zeros((C, self.W + 1, 4), np.int32)
+        buf[:, :, 0] = EV_END
+        out = stream_loop(
+            self.cfg,
+            jnp.asarray(buf),
+            self.state._replace(ptr=jnp.zeros(C, jnp.int32)),
+            jnp.zeros(C, bool),
+            jnp.zeros(C, jnp.int32),
+            jnp.asarray(0, jnp.int32),
+            has_sync=True,
+        )
+        np.asarray(out[0].cycles)  # block until compiled
+
     def run(self, max_steps: int | None = None) -> None:
         import jax.numpy as jnp
 

@@ -146,3 +146,36 @@ def test_cli_devices_runs_sharded(tmp_path, capsys):
     # golden engine has no device loop to shard
     with pytest.raises(SystemExit):
         main(args + ["--devices", "8", "--engine", "golden"])
+
+
+def test_cli_capture_online(tmp_path, capsys):
+    # one-command execution-driven mode: build the example binary, run it
+    # under `primetpu capture`, simulating WHILE it executes
+    import shutil
+    import subprocess
+
+    if shutil.which("gcc") is None:
+        import pytest
+
+        pytest.skip("no native toolchain")
+    frontend = os.path.join(REPO, "primesim_tpu", "frontend")
+    binary = str(tmp_path / "ocean_like")
+    subprocess.run(
+        ["gcc", "-O2", "-fno-builtin", "-o", binary,
+         os.path.join(frontend, "examples", "ocean_like.c"), "-lpthread"],
+        check=True, capture_output=True,
+    )
+    cfg_path = str(tmp_path / "m.json")
+    with open(cfg_path, "w") as f:
+        f.write(
+            MachineConfig(
+                n_cores=3, n_banks=4, quantum=10_000
+            ).to_json()
+        )
+    rc = main(["capture", cfg_path, "--window", "256", "--",
+               binary, "2", "1", "2"])
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["detail"]["engine"] == "online"
+    assert d["detail"]["instructions"] > 0
+    assert d["detail"]["events"] > 0
