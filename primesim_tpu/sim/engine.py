@@ -51,9 +51,6 @@ from .state import E, I, M, MachineState, S, init_state, llc_meta_width
 INT32_MAX = np.int32(2**31 - 1)
 _ACC_BITS = 30  # device counter accumulators carry into hi above 2^30
 
-_CIDX = {k: i for i, k in enumerate(COUNTER_NAMES)}
-
-
 @functools.lru_cache(maxsize=None)
 def _group_tables(cfg: MachineConfig):
     """Static per-(home tile, sharer group) reduction tables for the
