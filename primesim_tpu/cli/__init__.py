@@ -52,10 +52,21 @@ def _parse_synth(spec: str, n_cores: int, fold: bool):
 
 
 def _load_trace(ns, n_cores: int):
-    from ..trace.format import Trace, fold_ins
+    from ..trace.format import Trace, fold_ins, multiplex
 
     if ns.trace:
-        tr = Trace.load(ns.trace, mmap=getattr(ns, "mmap", False))
+        if len(ns.trace) > 1 and getattr(ns, "mmap", False):
+            raise SystemExit(
+                "--mmap is incompatible with multiple --trace flags: "
+                "multiplexing materializes the combined trace in RAM"
+            )
+        trs = [
+            Trace.load(p, mmap=getattr(ns, "mmap", False)) for p in ns.trace
+        ]
+        # several --trace flags = the reference's MULTIPROGRAMMED mode:
+        # each program gets a disjoint address window and sync objects,
+        # all sharing this machine's uncore
+        tr = trs[0] if len(trs) == 1 else multiplex(trs)
         return fold_ins(tr) if ns.fold else tr
     if ns.synth:
         return _parse_synth(ns.synth, n_cores, ns.fold)
@@ -301,7 +312,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     r = sub.add_parser("run", help="simulate a trace on a machine config")
     r.add_argument("config", help="machine config (.json or reference-schema .xml)")
-    r.add_argument("--trace", help="PTPU trace file")
+    r.add_argument(
+        "--trace", action="append",
+        help="PTPU trace file (repeat for a MULTIPROGRAMMED run: each "
+             "program's cores/addresses/sync multiplex into one machine)",
+    )
     r.add_argument("--synth", help="synthetic workload spec name[:k=v,...]")
     r.add_argument(
         "--fold", action="store_true", help="fold INS batches into pre fields"

@@ -927,53 +927,71 @@ def step(
             + R_lat
             + hidx * c_hop
         )
-        base = jnp.full(NL, INT32_MAX, jnp.int32)
-        for pth, mask, a in (
-            (req_p, home_txn, a_req),
-            (rep_p, home_txn, a_rep),
-        ) + (((arr_p, is_barrier, a_req),) if has_sync else ()):
-            ok = mask[:, None] & (pth >= 0)
-            tgt = jnp.where(ok, pth, NL)
-            U = U.at[arange_c[:, None], tgt].set(1, mode="drop")
-            base = base.at[tgt].min(a, mode="drop")
+        # EVERY per-link operation runs once over the concatenated paths
+        # ([C, 2H] legs, or [C, 3H] with the barrier-arrival leg): one U
+        # scatter, one base scatter-min, one rank take_along, one
+        # link_free/base gather pair — per-kernel overhead is the budget,
+        # so per-path loops are per-path kernels
+        pth_all = jnp.concatenate(
+            [req_p, rep_p] + ([arr_p] if has_sync else []), axis=1
+        )
+        mask_all = jnp.concatenate(
+            [
+                jnp.broadcast_to(home_txn[:, None], req_p.shape),
+                jnp.broadcast_to(home_txn[:, None], rep_p.shape),
+            ]
+            + (
+                [jnp.broadcast_to(is_barrier[:, None], arr_p.shape)]
+                if has_sync
+                else []
+            ),
+            axis=1,
+        )
+        a_all = jnp.concatenate(
+            [a_req, a_rep] + ([a_req] if has_sync else []), axis=1
+        )
+        ok_all = mask_all & (pth_all >= 0)
+        tgt_all = jnp.where(ok_all, pth_all, NL)
+        U = U.at[arange_c[:, None], tgt_all].set(1, mode="drop")
+        base = jnp.full(NL, INT32_MAX, jnp.int32).at[tgt_all].min(
+            a_all, mode="drop"
+        )
         ranks = jax.lax.dot_general(
             kless, U, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32,
         )  # [C, NL]: packets ahead of lane i in l's same-step FIFO
+        pc_all = jnp.where(pth_all >= 0, pth_all, 0)
+        r_all = jnp.take_along_axis(ranks, pc_all, axis=1)
+        F_all = jnp.where(
+            ok_all,
+            jnp.maximum(st.link_free[pc_all], base[pc_all]) + r_all * L_lat,
+            SENT,
+        )  # [C, legs*H] wait floors, one gather pair for every leg
 
-        def _cascade(t_start, pth, mask, nh):
-            ok = mask[:, None] & (pth >= 0)
-            pc = jnp.where(pth >= 0, pth, 0)
-            r = jnp.take_along_axis(ranks, pc, axis=1)
-            F = jnp.maximum(st.link_free[pc], base[pc]) + r * L_lat
-            G = jnp.where(ok, F, SENT) - hidx * c_hop
+        def _cascade(t_start, F, nh):
+            G = F - hidx * c_hop
             cum = jax.lax.cummax(G, axis=1)
             t1 = t_start + R_lat
             t_end = jnp.maximum(t1, cum[:, -1]) + nh * c_hop
             departs = jnp.maximum(t1[:, None], cum) + hidx * c_hop + L_lat
-            return t_end, departs, ok
+            return t_end, departs
 
         arr_lat_a, arr_hops = _one_way(ctile, htile, cfg)
-        t_req_end, d_req, ok_req = _cascade(t0, req_p, home_txn, req_hops)
-        t_rep_end, d_rep, ok_rep = _cascade(
-            t_req_end + service, rep_p, home_txn, rep_hops
+        t_req_end, d_req = _cascade(t0, F_all[:, :H], req_hops)
+        t_rep_end, d_rep = _cascade(
+            t_req_end + service, F_all[:, H : 2 * H], rep_hops
         )
         raw_rt = t_rep_end - t0  # valid on home_txn lanes
         extra_home = raw_rt - (req_lat + service + rep_lat)
+        deps = [d_req, d_rep]
         if has_sync:
-            t_arr_end, d_arr, ok_arr = _cascade(t0, arr_p, is_barrier, arr_hops)
+            t_arr_end, d_arr = _cascade(t0, F_all[:, 2 * H :], arr_hops)
             raw_arr = t_arr_end - t0  # valid on barrier lanes
             extra_bar = raw_arr - arr_lat_a
-            dep_all = jnp.concatenate([d_req, d_rep, d_arr], axis=1)
-            ok_all = jnp.concatenate([ok_req, ok_rep, ok_arr], axis=1)
-            pth_all = jnp.concatenate([req_p, rep_p, arr_p], axis=1)
-        else:
-            dep_all = jnp.concatenate([d_req, d_rep], axis=1)
-            ok_all = jnp.concatenate([ok_req, ok_rep], axis=1)
-            pth_all = jnp.concatenate([req_p, rep_p], axis=1)
-        link_free_n = st.link_free.at[
-            jnp.where(ok_all, pth_all, NL)
-        ].max(dep_all, mode="drop")
+            deps.append(d_arr)
+        link_free_n = st.link_free.at[tgt_all].max(
+            jnp.concatenate(deps, axis=1), mode="drop"
+        )
         cnt = cadd(
             cnt,
             "noc_contention_cycles",

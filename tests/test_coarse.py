@@ -123,3 +123,20 @@ def test_parity_coarse_with_local_runs():
 
     tr = fold_ins(synth.fft_like(8, n_phases=2, points_per_core=12, seed=35))
     assert_parity(cfg, tr, chunk_steps=16)
+
+
+def test_parity_coarse_with_router_and_dram_queue():
+    # every round-5 timing model stacked on the coarse directory: hop-by
+    # -hop router + controller queue + local runs + O3 — bit-exact
+    from primesim_tpu.config.machine import CoreConfig, NocConfig
+    from primesim_tpu.trace.format import fold_ins
+
+    cfg = small_test_config(
+        8, n_banks=8, quantum=500, local_run_len=4, sharer_group=4,
+        dram_queue=True, dram_service=40,
+        core=CoreConfig(o3_overlap_256=64),
+        noc=NocConfig(mesh_x=4, mesh_y=2, contention=True,
+                      contention_model="router"),
+    )
+    tr = fold_ins(synth.fft_like(8, n_phases=2, points_per_core=12, seed=36))
+    assert_parity(cfg, tr, chunk_steps=16)

@@ -1,0 +1,118 @@
+"""Multiprogrammed workloads (SURVEY.md §2 parallelism table / PriME's
+multiple-Pin-processes mode): several programs' traces multiplexed into
+one machine's core axis, sharing the LLC/NoC/DRAM but with disjoint
+address spaces and sync objects."""
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import small_test_config
+from primesim_tpu.golden.sim import GoldenSim
+from primesim_tpu.trace import synth
+from primesim_tpu.trace.format import (
+    EV_BARRIER,
+    EV_LD,
+    EV_ST,
+    multiplex,
+)
+
+from test_parity import assert_parity
+
+
+def test_address_spaces_disjoint():
+    a = synth.false_sharing(4, n_mem_ops=20, seed=1)
+    b = synth.false_sharing(4, n_mem_ops=20, seed=1)  # SAME program twice
+    m = multiplex([a, b])
+    assert m.n_cores == 8
+    ty = m.events[:, :, 0]
+    mem = (ty == EV_LD) | (ty == EV_ST)
+    addrs_a = set(np.unique(m.events[:4, :, 2][mem[:4]]).tolist())
+    addrs_b = set(np.unique(m.events[4:, :, 2][mem[4:]]).tolist())
+    # identical programs, but NO shared lines after multiplexing
+    assert addrs_a and addrs_b and not (addrs_a & addrs_b)
+
+
+def test_barrier_ids_offset():
+    a = synth.barrier_phases(4, n_phases=2, seed=2)
+    b = synth.barrier_phases(4, n_phases=2, seed=3)
+    m = multiplex([a, b])
+    bar = m.events[:, :, 0] == EV_BARRIER
+    bids_a = set(np.unique(m.events[:4, :, 2][bar[:4]]).tolist())
+    bids_b = set(np.unique(m.events[4:, :, 2][bar[4:]]).tolist())
+    assert bids_a and bids_b and not (bids_a & bids_b)
+
+
+def test_mixed_addressing_rejected():
+    a = synth.stream(4, n_mem_ops=10, seed=4)
+    la = a.line_events(6)
+    from primesim_tpu.trace.format import Trace
+
+    b_line = Trace(la, a.lengths, line_addressed=True, line_bits=6)
+    with pytest.raises(ValueError, match="addressing"):
+        multiplex([a, b_line])
+
+
+def test_window_overflow_rejected():
+    from primesim_tpu.trace.format import from_event_lists
+
+    big = from_event_lists([[(EV_LD, 4, 2**30)]])
+    with pytest.raises(ValueError, match="window"):
+        multiplex([big, big], prog_bits=4)
+
+
+def test_parity_multiprogrammed():
+    # two different programs contending for one small shared uncore:
+    # golden and engine bit-exact, and each program completes
+    cfg = small_test_config(8, n_banks=4, quantum=400)
+    m = multiplex(
+        [
+            synth.false_sharing(4, n_mem_ops=30, seed=5),
+            synth.stream(4, n_mem_ops=30, seed=6),
+        ]
+    )
+    assert_parity(cfg, m, chunk_steps=32)
+
+
+def test_multiprogram_sync_isolation():
+    # two barrier programs: each program's barriers release independently
+    # (offset ids), so per-core barrier_waits match the solo runs
+    cfg8 = small_test_config(8, n_banks=4, quantum=400)
+    cfg4 = small_test_config(4, n_banks=4, quantum=400)
+    a = synth.barrier_phases(4, n_phases=2, seed=7)
+    b = synth.barrier_phases(4, n_phases=3, seed=8)
+    m = multiplex([a, b])
+    g = GoldenSim(cfg8, m)
+    g.run()
+    ga = GoldenSim(cfg4, a)
+    ga.run()
+    gb = GoldenSim(cfg4, b)
+    gb.run()
+    np.testing.assert_array_equal(
+        g.counters["barrier_waits"][:4], ga.counters["barrier_waits"]
+    )
+    np.testing.assert_array_equal(
+        g.counters["barrier_waits"][4:], gb.counters["barrier_waits"]
+    )
+
+
+def test_cli_multiprogrammed_run(tmp_path, capsys):
+    import json
+    import os
+
+    from primesim_tpu.cli import main
+
+    a = tmp_path / "a.ptpu"
+    b = tmp_path / "b.ptpu"
+    synth.false_sharing(4, n_mem_ops=20, seed=9).save(str(a))
+    synth.stream(4, n_mem_ops=20, seed=10).save(str(b))
+    cfg_path = str(tmp_path / "m.json")
+    with open(cfg_path, "w") as f:
+        f.write(small_test_config(8, n_banks=4).to_json())
+    rc = main(
+        ["run", cfg_path, "--trace", str(a), "--trace", str(b),
+         "--chunk-steps", "16"]
+    )
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["detail"]["n_cores"] == 8
+    assert d["detail"]["instructions"] > 0
