@@ -51,7 +51,7 @@ def _parse_synth(spec: str, n_cores: int, fold: bool):
     return fold_ins(tr) if fold else tr
 
 
-def _load_trace(ns, n_cores: int):
+def _load_trace(ns, n_cores: int, line_bits: int = 6):
     from ..trace.format import Trace, fold_ins, multiplex
 
     if ns.trace:
@@ -66,7 +66,11 @@ def _load_trace(ns, n_cores: int):
         # several --trace flags = the reference's MULTIPROGRAMMED mode:
         # each program gets a disjoint address window and sync objects,
         # all sharing this machine's uncore
-        tr = trs[0] if len(trs) == 1 else multiplex(trs)
+        tr = (
+            trs[0]
+            if len(trs) == 1
+            else multiplex(trs, line_bits=line_bits)
+        )
         return fold_ins(tr) if ns.fold else tr
     if ns.synth:
         return _parse_synth(ns.synth, n_cores, ns.fold)
@@ -120,7 +124,7 @@ def _emit_summary(ns, cfg, engine_name, counters, cycles, wall, extra=None):
 
 def cmd_run(ns) -> int:
     cfg = _load_config(ns.config)
-    tr = _load_trace(ns, cfg.n_cores)
+    tr = _load_trace(ns, cfg.n_cores, line_bits=cfg.line_bits)
     if tr.n_cores != cfg.n_cores:
         raise SystemExit(
             f"trace has {tr.n_cores} cores but config has {cfg.n_cores}"

@@ -165,6 +165,12 @@ class MachineConfig:
     # `dram_queue_cycles`; golden and engine are bit-exact.
     dram_queue: bool = False
     dram_service: int = 0
+    # Route the dense sharer-expansion reductions through the Pallas TPU
+    # kernel (primesim_tpu/ops/reductions.py) instead of the jnp path —
+    # bit-identical results; full-map vectors only (the coarse/chunked
+    # modes have their own reduction shapes). On non-TPU backends the
+    # kernel runs interpreted, so tests exercise it everywhere.
+    pallas_reduce: bool = False
     quantum: int = 1000  # relaxed-sync quantum, cycles (the fidelity/speed knob)
     # Local-run length: how many LOCAL events (INS batches, L1 hits) each
     # core may retire per step BEFORE the one arbitrated uncore event
@@ -237,6 +243,13 @@ class MachineConfig:
             raise ValueError("barrier_slots must be a power of two")
         if not _is_pow2(self.sharer_group):
             raise ValueError("sharer_group must be a power of two >= 1")
+        if self.pallas_reduce and (
+            self.sharer_group > 1 or self.sharer_chunk_words
+        ):
+            raise ValueError(
+                "pallas_reduce covers the dense full-map reduction only "
+                "(sharer_group == 1, sharer_chunk_words == 0)"
+            )
         if self.sharer_chunk_words < 0:
             raise ValueError("sharer_chunk_words must be >= 0")
         if self.sharer_chunk_words and (

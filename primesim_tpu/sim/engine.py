@@ -801,6 +801,17 @@ def step(
         (inv_lat, inv_count, inv_hops, back_count, back_hops), _ = jax.lax.scan(
             _blk, (z5, z5, z5, z5, z5), jnp.arange(nblk, dtype=jnp.int32)
         )
+    elif cfg.pallas_reduce:
+        # same dense reduction as the branch below, as ONE Pallas kernel
+        # (SURVEY §2 #4's Pallas uncore piece); bit-identical
+        from ..ops.reductions import sharer_reductions
+
+        (inv_lat, inv_count, inv_hops, back_count, back_hops) = (
+            sharer_reductions(
+                cfg, shw, vic_shw, btile, vic_owner, inv_row, vic_valid,
+                arange_c,
+            )
+        )
     else:
         ttile = arange_c % n_tiles  # target tiles
         pair_lat, pair_hops = _one_way(btile[:, None], ttile[None, :], cfg)

@@ -338,7 +338,11 @@ def fold_ins(trace: Trace) -> Trace:
     return from_event_lists(out, line_addressed=trace.line_addressed)
 
 
-def multiplex(traces: list[Trace], prog_bits: int | None = None) -> Trace:
+def multiplex(
+    traces: list[Trace],
+    prog_bits: int | None = None,
+    line_bits: int = 6,
+) -> Trace:
     """Combine several programs' traces into ONE machine's trace — the
     reference's MULTIPROGRAMMED mode (SURVEY.md §2 parallelism table:
     "several trace streams multiplexed into the core axis"; PriME runs
@@ -348,7 +352,13 @@ def multiplex(traces: list[Trace], prog_bits: int | None = None) -> Trace:
     (default: just enough bits for the program count), and its barrier
     ids are offset past the earlier programs' — so programs share the
     LLC/NoC/DRAM (and contend there) but never false-share lines or sync
-    objects.
+    objects (lock identities fold the program id into their low LINE
+    bits because the engines' lock-slot hash uses
+    `line & (lock_slots-1)`; for byte-addressed traces `line_bits` names
+    the machine's line-offset width so the fold lands in line-index
+    bits — pass the target config's `cfg.line_bits`. Requires
+    prog_bits <= log2(lock_slots), true for any realistic program
+    count).
 
     All traces must use the same addressing (byte, or line with equal
     line_bits). Raises if any program's addresses overflow its window.
@@ -386,6 +396,18 @@ def multiplex(traces: list[Trace], prog_bits: int | None = None) -> Trace:
                 "working set)"
             )
         ev[:, :, 2] = np.where(mem, ev[:, :, 2] | (k << shift), ev[:, :, 2])
+        # lock identities additionally fold the program id into the LOW
+        # address bits: both engines hash the lock-table slot from
+        # `line & (lock_slots - 1)`, so a high-bit tag alone would let
+        # two programs' same-addressed mutexes serialize on one slot.
+        # Clearing the low prog_bits costs only legal conservative
+        # aliasing WITHIN a program (lock_slots is a hash table already).
+        lk = (ty == EV_LOCK) | (ty == EV_UNLOCK)
+        lo = 0 if la else line_bits  # fold into LINE-index bits
+        lk_mask = ((1 << prog_bits) - 1) << lo
+        ev[:, :, 2] = np.where(
+            lk, (ev[:, :, 2] & ~lk_mask) | (k << lo), ev[:, :, 2]
+        )
         bar = ty == EV_BARRIER
         n_bids = int(ev[:, :, 2][bar].max()) + 1 if bar.any() else 0
         ev[:, :, 2] = np.where(bar, ev[:, :, 2] + bid_base, ev[:, :, 2])
