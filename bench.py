@@ -55,6 +55,31 @@ def _measure(cfg, trace, chunk: int, runs: int = 3):
     return eng, min(walls), walls
 
 
+def _measure_fleet(cfg, traces, chunk: int, runs: int = 2) -> float:
+    """Best-of-N timed FleetEngine.run, same warm-up/upload protocol as
+    `_measure`: one compiled program batching len(traces) simulations."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from primesim_tpu.sim.fleet import FleetEngine, fleet_run_loop
+
+    warm = FleetEngine(cfg, traces, chunk_steps=chunk)
+    out = fleet_run_loop(
+        warm.geom_cfg, chunk, warm.events, warm.state,
+        jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+    )
+    np.asarray(out[0].cycles)  # block until compiled
+    walls = []
+    for _ in range(runs):
+        fl = FleetEngine(cfg, traces, chunk_steps=chunk)
+        fl.block_until_ready()
+        t0 = time.perf_counter()
+        fl.run(max_steps=10_000_000)
+        walls.append(time.perf_counter() - t0)
+    return min(walls)
+
+
 def main() -> None:
     import numpy as np
 
@@ -107,6 +132,31 @@ def main() -> None:
         "dram_queue_cycles": int(eng3.counters["dram_queue_cycles"].sum()),
     }
 
+    # fleet scaling: aggregate MIPS batching B independent simulations
+    # through ONE compiled program (sim.fleet) on the rung-1/64-core
+    # config. The ~2.8 ms/step floor is serial kernel-chain depth, not
+    # bytes, so on TPU the aggregate should scale well toward B=8; on CPU
+    # this records the shape without gating it.
+    r1_path = os.path.join(os.path.dirname(__file__), "configs",
+                           "rung1_64core_fft.json")
+    with open(r1_path) as f:
+        cfg1 = MachineConfig.from_json(f.read())
+    fleet_traces = [
+        fold_ins(
+            synth.fft_like(
+                cfg1.n_cores, n_phases=2, points_per_core=128,
+                ins_per_mem=8, seed=52 + b,
+            )
+        )
+        for b in range(8)
+    ]
+    fleet_scaling = {}
+    for bsz in (1, 4, 8):
+        trs = fleet_traces[:bsz]
+        total_ins = sum(t.total_instructions() for t in trs)
+        wall_b = _measure_fleet(cfg1, trs, CHUNK)
+        fleet_scaling[str(bsz)] = round(total_ins / wall_b / 1e6, 3)
+
     print(
         json.dumps(
             {
@@ -126,6 +176,10 @@ def main() -> None:
                     "local_run_len": RL,
                     "chunk_steps": CHUNK,
                     "rung3_shipped_config": detail_r3,
+                    # aggregate MIPS batching B sims through one program
+                    # (rung-1/64-core config, one distinct trace per
+                    # element)
+                    "fleet_scaling": fleet_scaling,
                     # STATIC RECORD: round-5 restructure evidence measured
                     # on TPU 2026-07-30 (prof_phase.py cumulative cuts /
                     # prof_bisect.py ablations, flagship shapes, rl=8).

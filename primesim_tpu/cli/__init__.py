@@ -6,6 +6,7 @@ one CLI:
 
     primetpu run configs/rung1_64core_fft.json --synth fft_like --report r.txt
     primetpu run cfg.json --trace app.ptpu --engine jax
+    primetpu sweep cfg.json --synth fft_like --vary llc_lat=10 --vary llc_lat=20
     primetpu synth lock_contention:n_critical=32 --cores 64 --out lc.ptpu
     primetpu info configs/rung3_1024core_o3.json
 
@@ -291,6 +292,136 @@ def cmd_capture(ns) -> int:
         src.close()
 
 
+def _parse_vary(spec: str) -> dict:
+    """Parse one --vary spec 'k=v[,k=v...]' into a timing-override dict
+    (keys validated against sim.fleet.KNOB_KEYS by the FleetEngine)."""
+    ov = {}
+    for pair in spec.split(","):
+        k, eq, v = pair.partition("=")
+        if not eq or not k:
+            raise SystemExit(f"bad --vary arg {pair!r} (want key=value)")
+        try:
+            ov[k] = int(v)
+        except ValueError:
+            raise SystemExit(
+                f"bad --vary arg {pair!r}: value must be an integer"
+            ) from None
+    return ov
+
+
+def cmd_sweep(ns) -> int:
+    """Fan a config + timing overrides and/or traces into ONE fleet run
+    (sim.fleet.FleetEngine): every element shares the compiled program —
+    one compilation per geometry — and the batch retires one event per
+    core per element per step. Emits one JSON summary line per element
+    (ordered by fleet index) plus a fleet_aggregate_MIPS line."""
+    import os
+
+    cfg = _load_config(ns.config)
+    from ..trace.format import Trace, fold_ins
+
+    traces = []
+    if ns.trace:
+        traces = [Trace.load(p) for p in ns.trace]
+        if ns.fold:
+            traces = [fold_ins(t) for t in traces]
+    for spec in ns.synth or []:
+        traces.append(_parse_synth(spec, cfg.n_cores, ns.fold))
+    if not traces:
+        raise SystemExit("sweep: need --trace FILE and/or --synth SPEC")
+    ovs = [_parse_vary(s) for s in (ns.vary or [])]
+    A, V = len(traces), len(ovs)
+    # fan rule: equal lengths pair up; a single trace (or single --vary)
+    # replicates across the other axis; anything else is ambiguous
+    if V == 0:
+        ovs = [{}] * A
+    elif A == 1 and V > 1:
+        traces = traces * V
+    elif V == 1 and A > 1:
+        ovs = ovs * A
+    elif A != V:
+        raise SystemExit(
+            f"sweep: {A} traces vs {V} --vary sets — lengths must match, "
+            "or one side must be a single entry to replicate"
+        )
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..sim.fleet import FleetEngine, fleet_run_loop
+
+    fleet = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps)
+    # warm the jit cache at the fleet's shapes (one chunk) — the shared
+    # protocol: reported MIPS measures simulation, not compilation
+    warm = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps)
+    out = fleet_run_loop(
+        warm.geom_cfg, warm.chunk_steps, warm.events, warm.state,
+        jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+    )
+    np.asarray(out[0].cycles)
+    fleet.block_until_ready()
+    t0 = time.perf_counter()
+    fleet.run(max_steps=ns.max_steps or 10_000_000)
+    wall = time.perf_counter() - t0
+
+    from ..stats.report import write_report
+
+    counters = fleet.counters
+    cycles = fleet.cycles
+    if ns.report_dir:
+        os.makedirs(ns.report_dir, exist_ok=True)
+    total_ins = 0
+    for i in range(fleet.n_elements):
+        ec = {k: v[i] for k, v in counters.items()}
+        ins = int(ec["instructions"].sum())
+        total_ins += ins
+        print(
+            json.dumps(
+                {
+                    "metric": "simulated_MIPS",
+                    "value": round(ins / wall / 1e6, 3),
+                    "unit": "MIPS",
+                    "detail": {
+                        "engine": "fleet",
+                        "fleet_index": i,
+                        "n_cores": cfg.n_cores,
+                        "instructions": ins,
+                        "max_core_cycles": int(cycles[i].max()),
+                        "overrides": ovs[i],
+                        "wall_s": round(wall, 3),
+                        "noc_msgs": int(ec["noc_msgs"].sum()),
+                    },
+                }
+            )
+        )
+        if ns.report_dir:
+            path = os.path.join(ns.report_dir, f"element_{i}.txt")
+            write_report(
+                path, fleet.elem_cfgs[i], ec, cycles[i], wall_s=wall,
+                per_core_limit=ns.per_core_limit,
+                title=f"primesim_tpu fleet element {i}",
+            )
+            print(f"report written to {path}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "fleet_aggregate_MIPS",
+                "value": round(total_ins / wall / 1e6, 3),
+                "unit": "MIPS",
+                "detail": {
+                    "engine": "fleet",
+                    "n_elements": fleet.n_elements,
+                    "n_cores": cfg.n_cores,
+                    "instructions": total_ins,
+                    "wall_s": round(wall, 3),
+                },
+            }
+        )
+    )
+    return 0
+
+
 def cmd_synth(ns) -> int:
     tr = _parse_synth(ns.spec, ns.cores, ns.fold)
     tr.save(ns.out)
@@ -360,6 +491,37 @@ def build_parser() -> argparse.ArgumentParser:
              "(cores/L1s by core, LLC/directory by bank; jax engine)",
     )
     r.set_defaults(fn=cmd_run)
+
+    w = sub.add_parser(
+        "sweep",
+        help="fan timing overrides and/or traces into ONE batched fleet "
+             "run (one compiled program; one report per element)",
+    )
+    w.add_argument("config", help="machine config (.json or .xml)")
+    w.add_argument(
+        "--trace", action="append",
+        help="PTPU trace file (repeat for per-element traces)",
+    )
+    w.add_argument(
+        "--synth", action="append",
+        help="synthetic workload spec name[:k=v,...] (repeatable)",
+    )
+    w.add_argument(
+        "--vary", action="append", metavar="K=V[,K=V...]",
+        help="one fleet element's timing overrides (repeatable; keys: "
+             "quantum, cpi, l1_lat, llc_lat, link_lat, router_lat, "
+             "dram_lat, dram_service, contention_lat)",
+    )
+    w.add_argument(
+        "--fold", action="store_true", help="fold INS batches into pre fields"
+    )
+    w.add_argument("--chunk-steps", type=int, default=256)
+    w.add_argument("--max-steps", type=int, default=None)
+    w.add_argument(
+        "--report-dir", help="write per-element text reports to this directory"
+    )
+    w.add_argument("--per-core-limit", type=int, default=64)
+    w.set_defaults(fn=cmd_sweep)
 
     c = sub.add_parser(
         "capture",

@@ -260,6 +260,28 @@ class MachineConfig:
                 f"n_sharer_words={self.n_sharer_words}"
             )
 
+    def timing_normalized(self) -> "MachineConfig":
+        """This config with every TRACED timing knob (sim.state.TimingKnobs:
+        quantum, cpi, cache/NoC/DRAM latencies) replaced by a fixed
+        placeholder. Geometry and model selectors survive untouched, so two
+        configs agree here iff they can share one compiled program — the
+        fleet engine's static jit key (timing comes from the traced knobs
+        carried in state, never from this config)."""
+        return dataclasses.replace(
+            self,
+            quantum=1,
+            core=dataclasses.replace(
+                self.core, cpi=1, cpi_per_core=None, cpi_pattern=None
+            ),
+            l1=dataclasses.replace(self.l1, latency=1),
+            llc=dataclasses.replace(self.llc, latency=1),
+            noc=dataclasses.replace(
+                self.noc, link_lat=1, router_lat=1, contention_lat=1
+            ),
+            dram_lat=1,
+            dram_service=0,
+        )
+
     # Derived geometry used by both engines --------------------------------
 
     @property

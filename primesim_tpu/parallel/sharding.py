@@ -30,7 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..sim.state import MachineState
+from ..sim.state import MachineState, TimingKnobs
 
 AXIS = "tiles"
 
@@ -69,6 +69,19 @@ def state_pspecs() -> MachineState:
         quantum_end=P(),
         step=P(),
         counters=P(None, AXIS),
+        # traced timing knobs: the per-core cpi vector shards with the
+        # cores it feeds; the scalars replicate
+        knobs=TimingKnobs(
+            quantum=P(),
+            cpi=P(AXIS),
+            l1_lat=P(),
+            llc_lat=P(),
+            link_lat=P(),
+            router_lat=P(),
+            dram_lat=P(),
+            dram_service=P(),
+            contention_lat=P(),
+        ),
     )
 
 

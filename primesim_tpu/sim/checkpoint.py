@@ -23,10 +23,42 @@ import numpy as np
 
 from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES
-from .state import MachineState
+from .state import MachineState, TimingKnobs
 
-_FORMAT = 3  # v3: fused dirm row (metadata + sharers) replaces
-# llc_meta/sharers; 5-plane l1; link_free/dram_free queue clocks
+_FORMAT = 4  # v3: fused dirm row (metadata + sharers) replaces
+# llc_meta/sharers; 5-plane l1; link_free/dram_free queue clocks.
+# v4: nested TimingKnobs state field (flattened to state_knobs__<name>
+# keys — npz holds flat arrays only).
+
+
+def _state_arrays(st: MachineState) -> dict[str, np.ndarray]:
+    """Flatten the state pytree to npz-storable arrays: plain fields as
+    `state_<name>`, the nested knobs as `state_knobs__<name>`."""
+    arrays = {}
+    for k, v in st._asdict().items():
+        if isinstance(v, TimingKnobs):
+            for kk, vv in v._asdict().items():
+                arrays[f"state_{k}__{kk}"] = np.asarray(vv)
+        else:
+            arrays[f"state_{k}"] = np.asarray(v)
+    return arrays
+
+
+def _state_from(z) -> MachineState:
+    """Rebuild a MachineState from a v4 npz (inverse of _state_arrays)."""
+    fields = {}
+    for k in MachineState._fields:
+        # nested-pytree fields are flattened, so the flat key is absent
+        if f"state_{k}" not in z:
+            fields[k] = TimingKnobs(
+                **{
+                    kk: jnp.asarray(z[f"state_{k}__{kk}"])
+                    for kk in TimingKnobs._fields
+                }
+            )
+        else:
+            fields[k] = jnp.asarray(z[f"state_{k}"])
+    return MachineState(**fields)
 
 
 def trace_fingerprint(trace) -> str:
@@ -44,8 +76,7 @@ def trace_fingerprint(trace) -> str:
 def save_checkpoint(path: str, engine) -> None:
     """Snapshot an Engine mid-run (drains device counters first)."""
     engine._drain()
-    st = engine.state
-    arrays = {f"state_{k}": np.asarray(v) for k, v in st._asdict().items()}
+    arrays = _state_arrays(engine.state)
     arrays["host_counters"] = np.stack(
         [engine.host_counters[k] for k in COUNTER_NAMES]
     )
@@ -70,8 +101,7 @@ def save_stream_checkpoint(path: str, eng) -> None:
     host accumulators. Valid whenever no device window is in flight —
     i.e. between `_advance_window` dispatches (`run_events` pauses
     there)."""
-    st = eng.state
-    arrays = {f"state_{k}": np.asarray(v) for k, v in st._asdict().items()}
+    arrays = _state_arrays(eng.state)
     arrays["host_counters"] = np.stack(
         [eng.host_counters[k] for k in COUNTER_NAMES]
     )
@@ -108,9 +138,7 @@ def load_stream_checkpoint(path: str, eng) -> None:
             f"{path}: checkpoint window_events {int(z['window_events'])} "
             f"!= engine {eng.W} (windows must match for bit-exact resume)"
         )
-    eng.state = MachineState(
-        **{k: jnp.asarray(z[f"state_{k}"]) for k in MachineState._fields}
-    )
+    eng.state = _state_from(z)
     eng.cursor = z["cursor"].astype(np.int64)
     eng.cycle_base = np.int64(z["cycle_base"])
     eng.steps_run = int(z["steps_run"])
@@ -133,6 +161,10 @@ def load_checkpoint(path: str, engine) -> None:
         raise ValueError(
             f"{path}: streaming checkpoint — resume it with a StreamEngine"
         )
+    if "fleet" in z:
+        raise ValueError(
+            f"{path}: fleet checkpoint — resume it with a FleetEngine"
+        )
     cfg_json = bytes(z["config_json"]).decode()
     if MachineConfig.from_json(cfg_json) != engine.cfg:
         raise ValueError(f"{path}: checkpoint config does not match engine config")
@@ -145,10 +177,7 @@ def load_checkpoint(path: str, engine) -> None:
             f"rows but this build defines {len(COUNTER_NAMES)} — saved by an "
             "incompatible version"
         )
-    fields = {
-        k: jnp.asarray(z[f"state_{k}"]) for k in MachineState._fields
-    }
-    st = MachineState(**fields)
+    st = _state_from(z)
     if engine.mesh is not None:
         # restore the multi-chip layout Engine.__init__ applies — without
         # this the full state materializes unsharded on one device
@@ -160,5 +189,71 @@ def load_checkpoint(path: str, engine) -> None:
     engine.steps_run = int(z["steps_run"])
     hc = z["host_counters"]
     engine.host_counters = {
+        k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
+    }
+
+
+def save_fleet_checkpoint(path: str, fleet) -> None:
+    """Snapshot a FleetEngine mid-run: the BATCHED state pytree (leading
+    axis = fleet element), per-element 64-bit cycle bases and counter
+    accumulators, and per-element config/trace fingerprints. Any chunk
+    boundary is a consistent cut, exactly as for the solo engine."""
+    fleet._drain()
+    arrays = _state_arrays(fleet.state)
+    arrays["host_counters"] = np.stack(
+        [fleet.host_counters[k] for k in COUNTER_NAMES]
+    )  # [n_counters, B, C]
+    np.savez_compressed(
+        path,
+        format=np.int64(_FORMAT),
+        fleet=np.int64(1),
+        cycle_base=fleet.cycle_base,  # [B] int64
+        steps_run=fleet.steps_run,  # [B] int64
+        configs_json=np.frombuffer(
+            json.dumps(
+                [json.loads(c.to_json()) for c in fleet.elem_cfgs]
+            ).encode(),
+            dtype=np.uint8,
+        ),
+        trace_shas=np.frombuffer(
+            ",".join(trace_fingerprint(t) for t in fleet.traces).encode(),
+            dtype=np.uint8,
+        ),
+        **arrays,
+    )
+
+
+def load_fleet_checkpoint(path: str, fleet) -> None:
+    """Restore a fleet snapshot into a freshly-built FleetEngine over the
+    same per-element (config, trace) list — order included (the batch
+    axis is positional). Resuming is bit-exact per element
+    (tests/test_checkpoint.py)."""
+    z = np.load(path)
+    if int(z["format"]) != _FORMAT or "fleet" not in z:
+        raise ValueError(f"{path}: not a compatible fleet checkpoint")
+    cfgs = [
+        MachineConfig.from_dict(d)
+        for d in json.loads(bytes(z["configs_json"]).decode())
+    ]
+    if cfgs != list(fleet.elem_cfgs):
+        raise ValueError(
+            f"{path}: checkpoint element configs do not match fleet"
+        )
+    shas = bytes(z["trace_shas"]).decode().split(",")
+    if shas != [trace_fingerprint(t) for t in fleet.traces]:
+        raise ValueError(
+            f"{path}: checkpoint element traces do not match fleet"
+        )
+    if z["state_counters"].shape[1] != len(COUNTER_NAMES):
+        raise ValueError(
+            f"{path}: checkpoint has {z['state_counters'].shape[1]} counter "
+            f"rows but this build defines {len(COUNTER_NAMES)} — saved by an "
+            "incompatible version"
+        )
+    fleet.state = _state_from(z)
+    fleet.cycle_base = z["cycle_base"].astype(np.int64)
+    fleet.steps_run = z["steps_run"].astype(np.int64)
+    hc = z["host_counters"]
+    fleet.host_counters = {
         k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
     }

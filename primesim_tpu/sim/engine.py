@@ -54,11 +54,13 @@ _ACC_BITS = 30  # device counter accumulators carry into hi above 2^30
 @functools.lru_cache(maxsize=None)
 def _group_tables(cfg: MachineConfig):
     """Static per-(home tile, sharer group) reduction tables for the
-    coarse vector (sharer_group > 1): member count, max round-trip
-    latency over members, and summed round-trip hops — the group-level
-    stand-ins for the full-map model's per-core [C, C] expansion, sized
-    [n_tiles, n_groups] instead. NumPy at trace time; constants in the
-    compiled graph."""
+    coarse vector (sharer_group > 1): member count, max one-way HOPS over
+    members, and summed round-trip hops — the group-level stand-ins for
+    the full-map model's per-core [C, C] expansion, sized
+    [n_tiles, n_groups] instead. GEOMETRY ONLY (latency knobs are traced
+    per simulation; round-trip latency is monotone in hops, so
+    2*(hmax*link + (hmax+1)*router) is computed from max2hops at the use
+    site). NumPy at trace time; constants in the compiled graph."""
     G = cfg.sharer_group
     C = cfg.n_cores
     n_grp = cfg.n_sharer_groups
@@ -69,30 +71,30 @@ def _group_tables(cfg: MachineConfig):
     mt = (ids % nt).astype(np.int64)
     gx, gy = mt % mx, mt // mx
     members = valid.sum(1).astype(np.int32)  # [n_grp]
-    max2lat = np.zeros((nt, n_grp), np.int32)
+    max2hops = np.zeros((nt, n_grp), np.int32)
     sum2hops = np.zeros((nt, n_grp), np.int32)
     step = max(1, (1 << 24) // (n_grp * G))  # bound temporaries to ~16M
     for lo in range(0, nt, step):
         t = np.arange(lo, min(lo + step, nt))
         tx, ty = (t % mx)[:, None, None], (t // mx)[:, None, None]
         h = np.abs(tx - gx[None]) + np.abs(ty - gy[None])  # [T, n_grp, G]
-        lat2 = 2 * (h * cfg.noc.link_lat + (h + 1) * cfg.noc.router_lat)
-        max2lat[t] = np.where(valid[None], lat2, 0).max(2).astype(np.int32)
+        max2hops[t] = np.where(valid[None], h, 0).max(2).astype(np.int32)
         sum2hops[t] = (
             np.where(valid[None], 2 * h, 0).sum(2).astype(np.int32)
         )
     # NumPy out (converted at each use site): caching jnp arrays created
     # inside a trace would leak that trace's tracers into later jits
-    return members, max2lat, sum2hops
+    return members, max2hops, sum2hops
 
 
-def _one_way(tile_a, tile_b, cfg: MachineConfig):
-    """Vectorized mesh latency + hop count (noc/mesh.py semantics)."""
+def _one_way(tile_a, tile_b, cfg: MachineConfig, kn):
+    """Vectorized mesh latency + hop count (noc/mesh.py semantics).
+    Latencies come from the traced knobs; cfg supplies geometry."""
     mx = cfg.noc.mesh_x
     ax, ay = tile_a % mx, tile_a // mx
     bx, by = tile_b % mx, tile_b // mx
     h = jnp.abs(ax - bx) + jnp.abs(ay - by)
-    return h * cfg.noc.link_lat + (h + 1) * cfg.noc.router_lat, h
+    return h * kn.link_lat + (h + 1) * kn.router_lat, h
 
 
 def _path_links(cfg: MachineConfig, a, b):
@@ -241,11 +243,19 @@ def step(
     S2, W2 = cfg.llc.sets, cfg.llc.ways
     NW = cfg.n_sharer_words
     MW = llc_meta_width(cfg)  # sharer words start here in a dirm row
-    Q = cfg.quantum
     T = events.shape[1]
     n_tiles = cfg.n_tiles
     arange_c = jnp.arange(C, dtype=jnp.int32)
-    cpi_vec = jnp.asarray(cfg.core.cpi_vector(C), jnp.int32)
+    # TIMING comes from the TRACED knob pytree carried in state, never
+    # from cfg (which is a jit-static arg and may be timing-normalized):
+    # one compiled program per GEOMETRY serves every timing variant, and
+    # the fleet engine vmaps per-simulation knob values over the batch
+    # axis. cfg keeps geometry and model selectors only.
+    kn = st.knobs
+    Q = kn.quantum
+    cpi_vec = kn.cpi
+    l1_lat = kn.l1_lat
+    llc_lat = kn.llc_lat
     # Counter deltas accumulate in a host-side dict of [C] lanes and fold
     # into the [n_counters, C] array in ONE stacked add at the end of the
     # step: each `.at[row].add` is its own dynamic-update-slice kernel,
@@ -419,7 +429,7 @@ def step(
         cost_k = jnp.where(
             is_ins_k,
             eargr * cpi_vec[:, None],
-            eprer * cpi_vec[:, None] + cfg.l1.latency,
+            eprer * cpi_vec[:, None] + l1_lat,
         )
         cost_p = jnp.where(pref, cost_k, 0)
         clock_before = (
@@ -584,8 +594,8 @@ def step(
     # ---- phase 3: directory transition on step-start state ---------------
     ctile = arange_c % n_tiles
     btile = bank % n_tiles
-    req_lat, req_hops = _one_way(ctile, btile, cfg)
-    rep_lat, rep_hops = _one_way(btile, ctile, cfg)
+    req_lat, req_hops = _one_way(ctile, btile, cfg, kn)
+    rep_lat, rep_hops = _one_way(btile, ctile, cfg, kn)
 
     # barrier home tile (bid lives in the addr field; ids validated
     # < barrier_slots at ingest) — shared by the contention count and the
@@ -608,7 +618,7 @@ def step(
     if has_sync:
         home_txn = home_txn | is_lock | is_unlock
     if cfg.noc.contention and not router:
-        ccl = cfg.noc.contention_lat
+        ccl = kn.contention_lat
         if cfg.noc.contention_model == "link":
             from ..noc.mesh import n_links
 
@@ -657,7 +667,7 @@ def step(
     has_owner = llc_hit & (owner >= 0) & (owner != arange_c)
     oclamp = jnp.maximum(owner, 0)
     otile = oclamp % n_tiles
-    po_lat, po_hops = _one_way(btile, otile, cfg)  # bank -> owner (symmetric back)
+    po_lat, po_hops = _one_way(btile, otile, cfg, kn)  # bank -> owner (symmetric back)
 
     is_write_req = getm | upg
     gets_w = gets & winner
@@ -694,9 +704,9 @@ def step(
     inv_row = write_w & llc_hit
     if cfg.sharer_group > 1:
         n_grp = cfg.n_sharer_groups
-        memb_n, max2lat_n, sum2hops_n = _group_tables(cfg)
+        memb_n, max2hops_n, sum2hops_n = _group_tables(cfg)
         memb = jnp.asarray(memb_n)
-        max2lat = jnp.asarray(max2lat_n)
+        max2hops = jnp.asarray(max2hops_n)
         sum2hops = jnp.asarray(sum2hops_n)
         bit5 = jnp.arange(32, dtype=jnp.int32)
 
@@ -706,7 +716,12 @@ def step(
 
         grp = _group_bools(shw)
         vic_grp = _group_bools(vic_shw)
-        ml_rows = max2lat[btile]  # [C, n_grp]
+        # round-trip latency 2*(h*link + (h+1)*router) is monotone
+        # nondecreasing in hop count, so the per-group max over members
+        # is the latency AT the max hop count — the geometry-only hops
+        # table composes with the TRACED link/router knobs here
+        mh_rows = max2hops[btile]  # [C, n_grp]
+        ml_rows = 2 * (mh_rows * kn.link_lat + (mh_rows + 1) * kn.router_lat)
         sumh_rows = sum2hops[btile]
         selfg = jnp.arange(n_grp, dtype=jnp.int32)[None, :] == g_c[:, None]
         self_rec = jnp.any(grp & selfg, axis=1)  # requester's group flagged
@@ -725,7 +740,7 @@ def step(
             - self_rec.astype(jnp.int32),
             0,
         )
-        _, self_hops = _one_way(btile, ctile, cfg)
+        _, self_hops = _one_way(btile, ctile, cfg, kn)
         inv_hops = jnp.where(
             inv_row,
             jnp.sum(jnp.where(grp, sumh_rows, 0), axis=1)
@@ -741,7 +756,7 @@ def step(
         )
         own_extra = (vic_owner >= 0) & ~own_rec
         _, own_hops = _one_way(
-            btile, jnp.maximum(vic_owner, 0) % n_tiles, cfg
+            btile, jnp.maximum(vic_owner, 0) % n_tiles, cfg, kn
         )
         back_count = jnp.where(
             vic_valid,
@@ -776,7 +791,7 @@ def step(
                 != 0
             )
             plat, phops = _one_way(
-                btile[:, None], (tt % n_tiles)[None, :], cfg
+                btile[:, None], (tt % n_tiles)[None, :], cfg, kn
             )
             sh_b = (
                 bits
@@ -814,7 +829,7 @@ def step(
         )
     else:
         ttile = arange_c % n_tiles  # target tiles
-        pair_lat, pair_hops = _one_way(btile[:, None], ttile[None, :], cfg)
+        pair_lat, pair_hops = _one_way(btile[:, None], ttile[None, :], cfg, kn)
         sh_bits = unpack_bits(shw)
         sh_bits = sh_bits & (arange_c[None, :] != arange_c[:, None])
         inv_pairs = sh_bits & inv_row[:, None]  # [C, C]
@@ -834,10 +849,10 @@ def step(
     # Ranks via the same int8 one-hot matmul; bit-exact vs golden
     # (tests/test_dram.py).
     if cfg.dram_queue:
-        svc_d = jnp.int32(cfg.dram_service or cfg.dram_lat)
+        svc_d = jnp.where(kn.dram_service > 0, kn.dram_service, kn.dram_lat)
         a_nom = (
-            cycles_c + epre * cpi_vec + cfg.l1.latency + req_lat
-            + cfg.llc.latency
+            cycles_c + epre * cpi_vec + l1_lat + req_lat
+            + llc_lat
         )
         dtgt = jnp.where(llc_miss, bank, B)
         dbase = jnp.full(B, INT32_MAX, jnp.int32).at[dtgt].min(
@@ -876,12 +891,12 @@ def step(
     # (joins, lock/unlock RMWs)
     service = jnp.where(
         winner,
-        cfg.llc.latency
+        llc_lat
         + jnp.where(probe_any, 2 * po_lat, 0)
         + jnp.where(write_w & llc_hit, inv_lat, 0)
-        + jnp.where(llc_miss, cfg.dram_lat, 0)
+        + jnp.where(llc_miss, kn.dram_lat, 0)
         + extra_dram,
-        cfg.llc.latency,
+        llc_lat,
     )
     link_free_n = st.link_free
     if router:
@@ -904,9 +919,9 @@ def step(
         from ..noc.mesh import n_links
 
         NL = n_links(cfg)
-        L_lat = jnp.int32(cfg.noc.link_lat)
-        R_lat = jnp.int32(cfg.noc.router_lat)
-        c_hop = jnp.int32(cfg.noc.link_lat + cfg.noc.router_lat)
+        L_lat = kn.link_lat
+        R_lat = kn.router_lat
+        c_hop = kn.link_lat + kn.router_lat
         SENT = jnp.int32(-(1 << 30) - (1 << 21))  # < any real wait floor
         req_p = _path_links(cfg, ctile, btile)  # [C, H]
         rep_p = _path_links(cfg, btile, ctile)
@@ -919,7 +934,7 @@ def step(
         t0 = (
             cycles_c
             + jnp.where(pre_chg, epre * cpi_vec, 0)
-            + jnp.where(mem_lane, cfg.l1.latency, 0)
+            + jnp.where(mem_lane, l1_lat, 0)
         )
         # canonical same-step order: the phase-2 arbitration key
         txn = home_txn | is_barrier
@@ -934,7 +949,7 @@ def step(
             t0[:, None]
             + R_lat
             + req_hops[:, None] * c_hop
-            + cfg.llc.latency
+            + llc_lat
             + R_lat
             + hidx * c_hop
         )
@@ -987,7 +1002,7 @@ def step(
             departs = jnp.maximum(t1[:, None], cum) + hidx * c_hop + L_lat
             return t_end, departs
 
-        arr_lat_a, arr_hops = _one_way(ctile, htile, cfg)
+        arr_lat_a, arr_hops = _one_way(ctile, htile, cfg, kn)
         t_req_end, d_req = _cascade(t0, F_all[:, :H], req_hops)
         t_rep_end, d_rep = _cascade(
             t_req_end + service, F_all[:, H : 2 * H], rep_hops
@@ -1009,13 +1024,13 @@ def step(
             jnp.where(home_txn, extra_home, 0)
             + (jnp.where(is_barrier, extra_bar, 0) if has_sync else 0),
         )
-        lat = cfg.l1.latency + raw_rt  # memory lanes (service included)
+        lat = l1_lat + raw_rt  # memory lanes (service included)
         lat_join = lat
     else:
-        lat = cfg.l1.latency + req_lat + service + rep_lat + extra_home
+        lat = l1_lat + req_lat + service + rep_lat + extra_home
         # join path: same shape — service is llc.latency on join lanes
         lat_join = (
-            cfg.l1.latency + req_lat + cfg.llc.latency + rep_lat + extra_home
+            l1_lat + req_lat + llc_lat + rep_lat + extra_home
         )
     ov = cfg.core.o3_overlap_256
     if ov:
@@ -1068,7 +1083,7 @@ def step(
     retired = is_ins | hit | winner | join
     mem_ret = hit | winner | join
     mem_lat = jnp.where(
-        hit, cfg.l1.latency, jnp.where(join, lat_join, lat)
+        hit, l1_lat, jnp.where(join, lat_join, lat)
     )
     cycles = cycles_c + jnp.where(
         is_ins,
@@ -1319,7 +1334,7 @@ def step(
             # time (pre charged on unlocks and first lock attempts only)
             lat_rt = raw_rt
         else:
-            lat_rt = lreq_lat + cfg.llc.latency + lrep_lat + extra_home
+            lat_rt = lreq_lat + llc_lat + lrep_lat + extra_home
 
         # unlocks: every unlock is a charged RMW round trip to the lock's
         # home; the slot is released only if this core actually holds it
@@ -1373,8 +1388,8 @@ def step(
         # barrier arrivals: charge pre + the arrival message, freeze the
         # core, bump the slot's count and max-arrival clock (bid/htile
         # hoisted above the contention block)
-        barr_lat, barr_hops = _one_way(ctile, htile, cfg)
-        wake_lat, wake_hops = _one_way(htile, ctile, cfg)
+        barr_lat, barr_hops = _one_way(ctile, htile, cfg, kn)
+        wake_lat, wake_hops = _one_way(htile, ctile, cfg, kn)
         barr_charge = raw_arr if router else barr_lat + extra_bar
         cycles = cycles + jnp.where(
             is_barrier, epre * cpi_vec + barr_charge, 0
@@ -1428,6 +1443,7 @@ def step(
         quantum_end=quantum_end,
         step=step_no + 1,
         counters=cflush(cnt),
+        knobs=kn,
     )
 
 
@@ -1471,7 +1487,7 @@ def _drain_and_rebase(cfg, st, acc_lo, acc_hi, base_lo, base_hi, nd):
     rebase the epoch-relative clocks by a whole number of quanta — the
     minimum over `nd` (not-done) lanes — including occupied barrier
     slots' arrival clocks."""
-    Q = cfg.quantum
+    Q = st.knobs.quantum  # traced — the fleet rebases per element
     acc_lo = acc_lo + st.counters
     acc_hi = acc_hi + (acc_lo >> _ACC_BITS)
     acc_lo = acc_lo & ((1 << _ACC_BITS) - 1)

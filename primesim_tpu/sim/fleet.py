@@ -1,0 +1,415 @@
+"""FleetEngine — batch B independent simulations through ONE program.
+
+Round-5 profiling (BENCH_r05.json) pinned the ~2.8 ms/step floor on the
+step's SERIAL kernel-chain depth, not bytes: isolated gathers/scatters of
+any tested shape cost ~0.02 ms, so each kernel launch is mostly idle
+capacity. PriME's headline use case is throughput across many concurrent
+runs (the ISPASS'14 multi-host aggregate bench.py baselines against), and
+a parameter sweep is the common shape of that traffic. So: `jax.vmap` the
+existing `run_chunk`/`run_loop` over a leading batch axis of B independent
+simulations sharing one GEOMETRY (core count, cache shapes, mesh), and one
+scan step retires one event per core *per simulation* at nearly the B=1
+kernel-chain cost.
+
+Two design points make a whole sweep ONE compilation:
+
+- The per-simulation TIMING knobs (quantum, cpi, cache/NoC/DRAM latencies
+  — `sim.state.TimingKnobs`) are TRACED, carried in `MachineState.knobs`
+  and stacked over the batch axis. The static jit key is
+  `cfg.timing_normalized()`: every timing variant of one geometry hits the
+  same cache entry.
+- Termination: `jax.vmap` of `lax.while_loop` runs the body while ANY
+  element's cond holds and SELECT-masks the carry, so finished elements
+  FREEZE at their own chunk boundary — exactly where a solo `run_loop`
+  with the same `chunk_steps` stops. Fleet element i is therefore
+  bit-exact with a solo `Engine` run of the same (config, trace),
+  including the step counter (tests/test_fleet.py).
+
+Scope: preloaded traces only. Streamed (windowed) ingest stays solo — the
+host-side window refill rate is per-element state, and batching it buys
+nothing while any element's refill stalls the fleet (see DESIGN.md §6).
+`pallas_reduce` configs are rejected: the Pallas kernel bakes link/router
+latencies in as static kernel params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.machine import MachineConfig
+from ..stats.counters import COUNTER_NAMES
+from ..trace.format import EV_BARRIER, EV_END, EV_LOCK, EV_UNLOCK, Trace
+from .engine import _ACC_BITS, _np, run_chunk, run_loop
+from .state import MachineState, init_state
+
+#: Override keys `apply_overrides` accepts — the TimingKnobs fields, named
+#: as a user would write them in a sweep spec.
+KNOB_KEYS = (
+    "quantum",
+    "cpi",
+    "l1_lat",
+    "llc_lat",
+    "link_lat",
+    "router_lat",
+    "dram_lat",
+    "dram_service",
+    "contention_lat",
+)
+
+
+def apply_overrides(cfg: MachineConfig, ov: dict | None) -> MachineConfig:
+    """A copy of `cfg` with the timing overrides `ov` applied — the
+    element's EFFECTIVE config (a solo Engine on it reproduces the fleet
+    element exactly). Keys are KNOB_KEYS; `cpi` takes an int (homogeneous)
+    or a length-n_cores sequence. Validation runs via the dataclass
+    constructors, plus the conflict-key packing bound on quantum."""
+    ov = dict(ov or {})
+    unknown = sorted(set(ov) - set(KNOB_KEYS))
+    if unknown:
+        raise ValueError(
+            f"unknown timing override(s) {unknown}; valid keys: {KNOB_KEYS}"
+        )
+    out = cfg
+    if "quantum" in ov:
+        out = dataclasses.replace(out, quantum=int(ov["quantum"]))
+    if "cpi" in ov:
+        v = ov["cpi"]
+        if isinstance(v, (int, np.integer)):
+            core = dataclasses.replace(
+                out.core, cpi=int(v), cpi_per_core=None, cpi_pattern=None
+            )
+        else:
+            core = dataclasses.replace(
+                out.core,
+                cpi_per_core=tuple(int(x) for x in v),
+                cpi_pattern=None,
+            )
+        out = dataclasses.replace(out, core=core)
+    if "l1_lat" in ov:
+        out = dataclasses.replace(
+            out, l1=dataclasses.replace(out.l1, latency=int(ov["l1_lat"]))
+        )
+    if "llc_lat" in ov:
+        out = dataclasses.replace(
+            out, llc=dataclasses.replace(out.llc, latency=int(ov["llc_lat"]))
+        )
+    noc_kw = {
+        k: int(ov[k])
+        for k in ("link_lat", "router_lat", "contention_lat")
+        if k in ov
+    }
+    if noc_kw:
+        out = dataclasses.replace(
+            out, noc=dataclasses.replace(out.noc, **noc_kw)
+        )
+    if "dram_lat" in ov:
+        out = dataclasses.replace(out, dram_lat=int(ov["dram_lat"]))
+    if "dram_service" in ov:
+        out = dataclasses.replace(out, dram_service=int(ov["dram_service"]))
+    if out.quantum * out.n_cores >= 2**31:
+        raise ValueError(
+            "quantum * n_cores must be < 2^31 (conflict-key packing); "
+            f"got {out.quantum} * {out.n_cores}"
+        )
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1), static_argnames=("has_sync",)
+)
+def fleet_run_chunk(
+    cfg: MachineConfig, n_steps: int, events, st: MachineState,
+    has_sync: bool = True,
+):
+    """`run_chunk` vmapped over the leading batch axis. `cfg` must be the
+    TIMING-NORMALIZED geometry config — timing comes from st.knobs."""
+    return jax.vmap(
+        lambda ev, s: run_chunk(cfg, n_steps, ev, s, has_sync=has_sync)
+    )(events, st)
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1), static_argnames=("has_sync",)
+)
+def fleet_run_loop(
+    cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
+    max_chunks, has_sync: bool = True,
+):
+    """`run_loop` vmapped over the leading batch axis: one dispatched
+    device program for a whole FLEET run. Per-element drain/rebase and
+    termination come out of the vmap for free — the while_loop cond
+    batches to any(live) and the carry select-masks, so each element's
+    (state, counter accumulators, cycle base, chunk count) freezes the
+    moment it finishes."""
+    return jax.vmap(
+        lambda ev, s: run_loop(
+            cfg, chunk_steps, ev, s, max_chunks, has_sync=has_sync
+        )
+    )(events, st)
+
+
+class FleetEngine:
+    """Host runner for a batch of independent simulations on one geometry.
+
+    Elements may differ in TRACE and in the traced TIMING knobs
+    (per-element `overrides` dicts, see KNOB_KEYS); everything else —
+    geometry and model selectors — comes from the shared `cfg`. The
+    public surface mirrors `Engine`, batched: `cycles` is [B, C],
+    `counters` maps name -> [B, C], and `element_*` accessors slice out
+    solo-shaped views.
+    """
+
+    def __init__(
+        self,
+        cfg: MachineConfig,
+        traces: list[Trace],
+        overrides: list[dict] | None = None,
+        chunk_steps: int = 256,
+    ):
+        if cfg.pallas_reduce:
+            raise ValueError(
+                "FleetEngine does not support pallas_reduce configs: the "
+                "Pallas reduction kernel takes link/router latencies as "
+                "static kernel parameters, which defeats the fleet's "
+                "traced-knob compilation sharing"
+            )
+        traces = list(traces)
+        if not traces:
+            raise ValueError("FleetEngine needs at least one trace")
+        if overrides is None:
+            overrides = [{}] * len(traces)
+        overrides = list(overrides)
+        if len(overrides) != len(traces):
+            raise ValueError(
+                f"got {len(traces)} traces but {len(overrides)} override "
+                "dicts (must match 1:1)"
+            )
+        B = len(traces)
+        C = cfg.n_cores
+        self.cfg = cfg
+        # effective per-element configs (a solo Engine on elem_cfgs[i] +
+        # traces[i] reproduces element i bit-exactly); building them also
+        # validates every override combination
+        self.elem_cfgs = [apply_overrides(cfg, ov) for ov in overrides]
+        # the static jit key: one compilation per GEOMETRY
+        self.geom_cfg = cfg.timing_normalized()
+        self.traces = traces
+        from ..trace.format import validate_sync
+
+        has_sync = False
+        for t in traces:
+            if t.n_cores != C:
+                raise ValueError(
+                    f"trace has {t.n_cores} cores, config {C}"
+                )
+            validate_sync(t, cfg.barrier_slots)
+            ty = t.events[:, :, 0]
+            has_sync = has_sync or bool(
+                ((ty == EV_LOCK) | (ty == EV_UNLOCK) | (ty == EV_BARRIER)).any()
+            )
+        # static specialization is shared: ANY element with sync events
+        # turns phase 2.7 on for the whole fleet (a no-op for the others)
+        self.has_sync = has_sync
+        # events: per-element line-event arrays END-padded to a common T
+        # and stacked [B, C, T, 4] (END padding is the format's own
+        # convention — engines clamp ptr to T-1)
+        T = max(t.max_len for t in traces)
+        evs = []
+        for t in traces:
+            e = np.asarray(t.line_events(cfg.line_bits))
+            if e.shape[1] < T:
+                pad = np.zeros((C, T - e.shape[1], 4), e.dtype)
+                pad[:, :, 0] = EV_END
+                e = np.concatenate([e, pad], axis=1)
+            evs.append(e)
+        self._events_np = np.stack(evs)
+        self.events = jnp.asarray(self._events_np)
+        # state: stack the elements' solo init states — init_state(elem
+        # cfg) already seeds knobs and quantum_end from the element's
+        # effective timing
+        states = [init_state(c) for c in self.elem_cfgs]
+        self.state = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        self.chunk_steps = chunk_steps
+        # same per-chunk counter-accumulator bound as Engine, over the
+        # worst event of ANY element
+        per_ev = max(
+            1,
+            max(int(t.events[:, :, 1].max(initial=0)) for t in traces),
+            max(int(t.events[:, :, 3].max(initial=0)) for t in traces) + 1,
+        )
+        per_step = (cfg.local_run_len + 1) * per_ev
+        if chunk_steps * per_step >= 1 << _ACC_BITS:
+            raise ValueError(
+                f"chunk_steps={chunk_steps} x max per-step instruction "
+                f"increment {per_step} overflows the 2^{_ACC_BITS} "
+                "per-chunk counter accumulator; lower chunk_steps or split "
+                "large INS batches"
+            )
+        self.cycle_base = np.zeros(B, np.int64)
+        self.host_counters = {
+            k: np.zeros((B, C), np.int64) for k in COUNTER_NAMES
+        }
+        self.steps_run = np.zeros(B, np.int64)
+
+    # ---- batched bookkeeping (Engine's host helpers, vectorized) ---------
+
+    @property
+    def n_elements(self) -> int:
+        return len(self.traces)
+
+    def _drain(self) -> None:
+        cnt = _np(self.state.counters)  # [B, n_counters, C]
+        for i, k in enumerate(COUNTER_NAMES):
+            self.host_counters[k] += cnt[:, i].astype(np.int64)
+        self.state = self.state._replace(
+            counters=jnp.zeros_like(self.state.counters)
+        )
+
+    def _event_types_at_ptr(self) -> np.ndarray:
+        """[B, C] event type codes under each element's trace pointer
+        (reads the padded host copy — END padding included)."""
+        p = np.minimum(_np(self.state.ptr), self._events_np.shape[2] - 1)
+        B, C = p.shape
+        return self._events_np[
+            np.arange(B)[:, None], np.arange(C)[None, :], p, 0
+        ]
+
+    def done_mask(self) -> np.ndarray:
+        return (self._event_types_at_ptr() == EV_END).all(axis=1)
+
+    def done(self) -> bool:
+        return bool(self.done_mask().all())
+
+    def _rebase(self) -> None:
+        """Per-element host rebase (run_steps path; `run` rebases on
+        device): shift each live element's epoch-relative clocks down by
+        a multiple of ITS quantum."""
+        cyc = _np(self.state.cycles)  # [B, C]
+        nd = self._event_types_at_ptr() != EV_END
+        quanta = np.asarray([c.quantum for c in self.elem_cfgs], np.int64)
+        m = np.where(nd, cyc, np.iinfo(np.int32).max).min(axis=1)
+        delta = np.where(nd.any(axis=1), (m // quanta) * quanta, 0)
+        delta = np.maximum(delta, 0)
+        if not (delta > 0).any():
+            return
+        self.cycle_base += delta
+        d = jnp.asarray(delta.astype(np.int32))  # [B]
+        st = self.state
+        self.state = st._replace(
+            cycles=st.cycles - d[:, None],
+            quantum_end=st.quantum_end - d,
+            barrier_time=jnp.where(
+                st.barrier_count > 0,
+                st.barrier_time - d[:, None],
+                st.barrier_time,
+            ),
+            link_free=(
+                jnp.maximum(st.link_free - d[:, None], -(1 << 30))
+                if self.cfg.noc.contention
+                and self.cfg.noc.contention_model == "router"
+                else st.link_free
+            ),
+            dram_free=(
+                jnp.maximum(st.dram_free - d[:, None], -(1 << 30))
+                if self.cfg.dram_queue
+                else st.dram_free
+            ),
+        )
+
+    # ---- run -------------------------------------------------------------
+
+    def run(self, max_steps: int = 10_000_000) -> None:
+        """Run every element to completion in ONE device dispatch."""
+        max_chunks = -(-max_steps // self.chunk_steps)
+        st, acc_lo, acc_hi, base_lo, base_hi, k = fleet_run_loop(
+            self.geom_cfg,
+            self.chunk_steps,
+            self.events,
+            self.state,
+            jnp.asarray(max_chunks, jnp.int32),
+            has_sync=self.has_sync,
+        )
+        acc_lo = _np(acc_lo).astype(np.int64)  # [B, n_counters, C]
+        acc_hi = _np(acc_hi).astype(np.int64)
+        total = (acc_hi << _ACC_BITS) + acc_lo
+        for i, name in enumerate(COUNTER_NAMES):
+            self.host_counters[name] += total[:, i]
+        self.cycle_base += (
+            _np(base_hi).astype(np.int64) << _ACC_BITS
+        ) + _np(base_lo).astype(np.int64)
+        self.state = st
+        self.steps_run += _np(k).astype(np.int64) * self.chunk_steps
+        if not self.done():
+            bad = np.flatnonzero(~self.done_mask()).tolist()
+            raise RuntimeError(
+                f"fleet: max_steps exceeded on element(s) {bad} (deadlock?)"
+            )
+
+    def run_steps(self, n_steps: int) -> None:
+        """Advance every LIVE element by `n_steps` (whole chunks) without
+        the completion check — the checkpointed-run building block.
+
+        Unlike `run` (whose batched while_loop select-masks finished
+        elements), the plain vmapped scan steps EVERY element; a finished
+        element's steps are no-ops except the `step` counter (phase 0
+        proves quantum_end cannot bump once every core sits at END), so
+        its machine state stays bit-exact while `state.step` may run
+        ahead of a solo engine's."""
+        target = int(self.steps_run.max()) + n_steps
+        while int(self.steps_run.max()) < target and not self.done():
+            live = ~self.done_mask()
+            self.state = fleet_run_chunk(
+                self.geom_cfg,
+                self.chunk_steps,
+                self.events,
+                self.state,
+                has_sync=self.has_sync,
+            )
+            self.steps_run += np.where(live, self.chunk_steps, 0)
+            self._drain()
+            self._rebase()
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.events)
+        jax.block_until_ready(self.state)
+
+    # ---- results ---------------------------------------------------------
+
+    @property
+    def cycles(self) -> np.ndarray:
+        """[B, C] absolute core clocks."""
+        return (
+            _np(self.state.cycles).astype(np.int64)
+            + self.cycle_base[:, None]
+        )
+
+    @property
+    def counters(self) -> dict[str, np.ndarray]:
+        """name -> [B, C] int64."""
+        self._drain()
+        return self.host_counters
+
+    def element_state(self, i: int) -> MachineState:
+        """Element i's machine state, solo-shaped (batch axis sliced)."""
+        return jax.tree.map(lambda x: x[i], self.state)
+
+    def element_counters(self, i: int) -> dict[str, np.ndarray]:
+        self._drain()
+        return {k: v[i] for k, v in self.host_counters.items()}
+
+    # ---- checkpoint / resume --------------------------------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        from .checkpoint import save_fleet_checkpoint
+
+        save_fleet_checkpoint(path, self)
+
+    def load_checkpoint(self, path: str) -> None:
+        from .checkpoint import load_fleet_checkpoint
+
+        load_fleet_checkpoint(path, self)

@@ -36,6 +36,47 @@ def dirm_width(cfg: MachineConfig) -> int:
     return llc_meta_width(cfg) + cfg.llc.ways * cfg.n_sharer_words
 
 
+class TimingKnobs(NamedTuple):
+    """Per-simulation TIMING knobs, lifted out of the static
+    `MachineConfig` into TRACED device scalars/vectors so one compiled
+    program serves a whole parameter sweep (the fleet engine vmaps them
+    over a leading batch axis; solo engines carry the config's values).
+    GEOMETRY (core count, sets/ways, mesh shape, slot tables) and model
+    SELECTORS (contention_model, dram_queue, sharer_group, local_run_len,
+    o3_overlap_256) stay static — they change array shapes or the traced
+    graph itself. All int32, like every clock they feed."""
+
+    quantum: jnp.ndarray  # [] — relaxed-sync quantum, cycles
+    cpi: jnp.ndarray  # [C] — per-core non-memory CPI
+    l1_lat: jnp.ndarray  # [] — L1 hit/lookup latency
+    llc_lat: jnp.ndarray  # [] — LLC bank lookup latency
+    link_lat: jnp.ndarray  # [] — per-hop mesh link traversal
+    router_lat: jnp.ndarray  # [] — per-router latency
+    dram_lat: jnp.ndarray  # [] — DRAM access latency
+    dram_service: jnp.ndarray  # [] — controller occupancy (0 -> dram_lat)
+    contention_lat: jnp.ndarray  # [] — queueing cycles per transaction
+
+
+def knobs_from_config(cfg: MachineConfig) -> TimingKnobs:
+    """The config's timing values as a traced-knob pytree (the solo
+    engine's knobs; fleet elements override per batch entry)."""
+
+    def i32(v):
+        return jnp.asarray(v, jnp.int32)
+
+    return TimingKnobs(
+        quantum=i32(cfg.quantum),
+        cpi=jnp.asarray(cfg.core.cpi_vector(cfg.n_cores), jnp.int32),
+        l1_lat=i32(cfg.l1.latency),
+        llc_lat=i32(cfg.llc.latency),
+        link_lat=i32(cfg.noc.link_lat),
+        router_lat=i32(cfg.noc.router_lat),
+        dram_lat=i32(cfg.dram_lat),
+        dram_service=i32(cfg.dram_service),
+        contention_lat=i32(cfg.noc.contention_lat),
+    )
+
+
 class MachineState(NamedTuple):
     # core (CoreManager)
     cycles: jnp.ndarray  # [C] int32 — per-core clock (epoch-relative)
@@ -100,6 +141,10 @@ class MachineState(NamedTuple):
     step: jnp.ndarray  # [] int32
     # stat counters, one row per COUNTER_NAMES entry
     counters: jnp.ndarray  # [n_counters, C] int32
+    # traced per-simulation timing knobs (see TimingKnobs): constant
+    # through a run (step passes them through), but TRACED so one
+    # compiled program serves every timing variant of one geometry
+    knobs: TimingKnobs
 
 
 def init_state(cfg: MachineConfig) -> MachineState:
@@ -141,6 +186,7 @@ def init_state(cfg: MachineConfig) -> MachineState:
         quantum_end=jnp.asarray(cfg.quantum, jnp.int32),
         step=jnp.asarray(0, jnp.int32),
         counters=jnp.zeros((len(COUNTER_NAMES), C), jnp.int32),
+        knobs=knobs_from_config(cfg),
     )
 
 

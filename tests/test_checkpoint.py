@@ -14,8 +14,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _full_state_equal(a, b):
     for k in a._fields:
+        va, vb = getattr(a, k), getattr(b, k)
+        if hasattr(va, "_fields"):  # nested pytree (TimingKnobs)
+            _full_state_equal(va, vb)
+            continue
         np.testing.assert_array_equal(
-            np.asarray(getattr(a, k)), np.asarray(getattr(b, k)), err_msg=k
+            np.asarray(va), np.asarray(vb), err_msg=k
         )
 
 
@@ -127,6 +131,76 @@ def test_checkpoint_rejects_mismatches(tmp_path):
     other_tr = synth.stream(4, n_mem_ops=10, seed=99)
     with pytest.raises(ValueError, match="trace does not match"):
         Engine(cfg, other_tr, chunk_steps=8).load_checkpoint(ckpt)
+
+
+def test_fleet_checkpoint_resume_bit_exact(tmp_path):
+    # fleet snapshots carry the BATCHED state plus per-element 64-bit
+    # cycle bases / counter accumulators; resume must be bit-exact per
+    # element against an uninterrupted fleet run
+    from primesim_tpu.sim.fleet import FleetEngine
+
+    cfg = small_test_config(8, n_banks=4, quantum=200)
+    traces = [
+        synth.fft_like(8, n_phases=2, points_per_core=12, seed=45),
+        synth.lock_contention(8, n_critical=8, seed=46),
+        synth.false_sharing(8, n_mem_ops=40, seed=47),
+    ]
+    overrides = [{}, {"llc_lat": 25, "quantum": 150}, {"dram_lat": 140}]
+    ckpt = str(tmp_path / "fleet.npz")
+
+    ref = FleetEngine(cfg, traces, overrides, chunk_steps=16)
+    ref.run()
+    ref_counters = {k: v.copy() for k, v in ref.counters.items()}
+
+    a = FleetEngine(cfg, traces, overrides, chunk_steps=16)
+    a.run_steps(48)
+    assert not a.done()  # mid-run cut
+    a.save_checkpoint(ckpt)
+
+    b = FleetEngine(cfg, traces, overrides, chunk_steps=16)
+    b.load_checkpoint(ckpt)
+    b.run()
+
+    np.testing.assert_array_equal(b.cycles, ref.cycles)
+    _full_state_equal(b.state, ref.state)
+    bc = b.counters
+    for k, v in ref_counters.items():
+        np.testing.assert_array_equal(bc[k], v, err_msg=k)
+
+
+def test_fleet_checkpoint_rejects_mismatches(tmp_path):
+    from primesim_tpu.sim.fleet import FleetEngine
+
+    cfg = small_test_config(4, n_banks=4)
+    traces = [
+        synth.stream(4, n_mem_ops=20, seed=48),
+        synth.uniform_random(4, n_mem_ops=20, seed=49),
+    ]
+    fl = FleetEngine(cfg, traces, [{}, {"llc_lat": 20}], chunk_steps=8)
+    fl.run_steps(8)
+    ckpt = str(tmp_path / "fleet.npz")
+    fl.save_checkpoint(ckpt)
+
+    # a plain Engine must refuse a fleet checkpoint, and vice versa
+    with pytest.raises(ValueError, match="[Ff]leet"):
+        Engine(cfg, traces[0], chunk_steps=8).load_checkpoint(ckpt)
+    solo_ckpt = str(tmp_path / "solo.npz")
+    e = Engine(cfg, traces[0], chunk_steps=8)
+    e.run_steps(8)
+    e.save_checkpoint(solo_ckpt)
+    with pytest.raises(ValueError, match="fleet checkpoint"):
+        FleetEngine(cfg, traces, chunk_steps=8).load_checkpoint(solo_ckpt)
+
+    # element configs (overrides included) and traces are part of the
+    # resume contract — the batch axis is positional
+    with pytest.raises(ValueError, match="configs do not match"):
+        FleetEngine(cfg, traces, [{}, {"llc_lat": 99}],
+                    chunk_steps=8).load_checkpoint(ckpt)
+    with pytest.raises(ValueError, match="traces do not match"):
+        FleetEngine(
+            cfg, list(reversed(traces)), [{}, {"llc_lat": 20}],
+            chunk_steps=8,
+        ).load_checkpoint(ckpt)
 
 
 def test_accumulator_guard_rejects_oversized_chunks():

@@ -1,0 +1,252 @@
+"""FleetEngine correctness (ISSUE 2 tentpole).
+
+The contract is crisp: fleet element i must be BIT-EXACT with a solo
+`Engine` run of the same effective (config, trace) — final cycles, every
+stat counter, and the full machine state (L1/LLC/directory arrays, sync
+tables, LRU stamps, even the step counter: the batched while_loop
+select-masks finished elements at exactly the chunk boundary where a solo
+run_loop with the same chunk_steps stops). And a whole parameter sweep
+must be ONE compilation: the static jit key is the timing-normalized
+geometry, with every timing knob traced.
+"""
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import small_test_config
+from primesim_tpu.sim.engine import Engine
+from primesim_tpu.sim.fleet import (
+    FleetEngine,
+    apply_overrides,
+    fleet_run_loop,
+)
+from primesim_tpu.trace import synth
+
+
+def assert_element_matches_solo(fleet, i, cfg_eff, trace, chunk_steps):
+    solo = Engine(cfg_eff, trace, chunk_steps=chunk_steps)
+    solo.run()
+    np.testing.assert_array_equal(
+        fleet.cycles[i], solo.cycles, err_msg=f"elem {i} cycles"
+    )
+    fc = fleet.element_counters(i)
+    for k, v in solo.counters.items():
+        np.testing.assert_array_equal(
+            fc[k], v, err_msg=f"elem {i} counter {k}"
+        )
+    es = fleet.element_state(i)
+    for f in es._fields:
+        if f == "knobs":
+            continue  # knobs are inputs, compared via cfg_eff already
+        np.testing.assert_array_equal(
+            np.asarray(getattr(es, f)),
+            np.asarray(getattr(solo.state, f)),
+            err_msg=f"elem {i} state field {f}",
+        )
+
+
+def test_fleet_parity_mixed_traces_and_knobs():
+    # the acceptance bar: B=4 elements, ALL with distinct traces AND
+    # distinct traced timing knobs, one of them a sync (lock) workload
+    cfg = small_test_config(8, n_banks=4, quantum=300)
+    traces = [
+        synth.false_sharing(8, n_mem_ops=40, seed=11),
+        synth.uniform_random(8, n_mem_ops=60, seed=12),
+        synth.lock_contention(8, n_critical=6, seed=13),
+        synth.barrier_phases(8, n_phases=3, seed=14),
+    ]
+    overrides = [
+        {},
+        {"llc_lat": 25, "dram_lat": 140, "l1_lat": 4},
+        {"quantum": 150, "cpi": 2},
+        {"link_lat": 3, "router_lat": 2, "cpi": [1, 2, 1, 2, 3, 1, 1, 2]},
+    ]
+    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
+    fleet.run()
+    assert fleet.done() and list(fleet.done_mask()) == [True] * 4
+    for i, (t, ov) in enumerate(zip(traces, overrides)):
+        assert_element_matches_solo(
+            fleet, i, apply_overrides(cfg, ov), t, chunk_steps=32
+        )
+
+
+def test_fleet_parity_contention_and_dram_queue_knobs():
+    # traced knobs that feed the queueing models: contention_lat (tile
+    # model) and dram_service/dram_lat (memory-controller queue)
+    cfg = small_test_config(
+        8,
+        n_banks=4,
+        dram_queue=True,
+        dram_service=20,
+    )
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg,
+        noc=dataclasses.replace(cfg.noc, contention=True,
+                                contention_model="tile"),
+    )
+    traces = [
+        synth.false_sharing(8, n_mem_ops=40, seed=21),
+        synth.uniform_random(8, n_mem_ops=50, seed=22),
+        synth.fft_like(8, n_phases=2, points_per_core=12, seed=23),
+    ]
+    overrides = [
+        {},
+        {"contention_lat": 7, "dram_service": 35},
+        {"dram_service": 0, "dram_lat": 90, "contention_lat": 2},
+    ]
+    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
+    fleet.run()
+    for i, (t, ov) in enumerate(zip(traces, overrides)):
+        assert_element_matches_solo(
+            fleet, i, apply_overrides(cfg, ov), t, chunk_steps=32
+        )
+
+
+def test_fleet_parity_router_model():
+    # the router NoC model's link_free clocks rebase per element with a
+    # per-element quantum — the hairiest drain/rebase interaction
+    import dataclasses
+
+    cfg = small_test_config(8, n_banks=4, quantum=400)
+    cfg = dataclasses.replace(
+        cfg,
+        noc=dataclasses.replace(
+            cfg.noc, contention=True, contention_model="router"
+        ),
+    )
+    traces = [
+        synth.false_sharing(8, n_mem_ops=40, seed=31),
+        synth.uniform_random(8, n_mem_ops=50, seed=32),
+        synth.false_sharing(8, n_mem_ops=40, seed=33),
+    ]
+    overrides = [{}, {"link_lat": 4, "quantum": 250}, {"router_lat": 5}]
+    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=16)
+    fleet.run()
+    for i, (t, ov) in enumerate(zip(traces, overrides)):
+        assert_element_matches_solo(
+            fleet, i, apply_overrides(cfg, ov), t, chunk_steps=16
+        )
+
+
+def test_fleet_one_compilation_per_geometry():
+    # changing only TRACED timing knobs between fleet runs must not
+    # retrigger compilation; changing geometry must
+    cfg = small_test_config(8, n_banks=4)
+    traces = [synth.uniform_random(8, n_mem_ops=30, seed=41)]
+    f1 = FleetEngine(cfg, traces, [{"llc_lat": 12}], chunk_steps=16)
+    f1.run()
+    n0 = fleet_run_loop._cache_size()
+    f2 = FleetEngine(
+        cfg, traces, [{"llc_lat": 33, "quantum": 500, "cpi": 3}],
+        chunk_steps=16,
+    )
+    f2.run()
+    assert fleet_run_loop._cache_size() == n0, (
+        "knob-only change recompiled the fleet loop"
+    )
+    # sanity: the two runs really simulated different machines
+    assert int(f1.cycles.max()) != int(f2.cycles.max())
+    cfg_geo = small_test_config(4, n_banks=4)
+    f3 = FleetEngine(
+        cfg_geo, [synth.uniform_random(4, n_mem_ops=30, seed=42)],
+        chunk_steps=16,
+    )
+    f3.run()
+    assert fleet_run_loop._cache_size() == n0 + 1  # new geometry compiles
+
+
+def test_fleet_rejections():
+    cfg = small_test_config(4, n_banks=4)
+    tr = synth.stream(4, n_mem_ops=10, seed=51)
+    with pytest.raises(ValueError, match="at least one trace"):
+        FleetEngine(cfg, [])
+    with pytest.raises(ValueError, match="must match 1:1"):
+        FleetEngine(cfg, [tr], [{}, {}])
+    with pytest.raises(ValueError, match="unknown timing override"):
+        FleetEngine(cfg, [tr], [{"llc_latency": 3}])
+    with pytest.raises(ValueError, match="pallas"):
+        FleetEngine(
+            small_test_config(4, n_banks=4, pallas_reduce=True), [tr]
+        )
+    with pytest.raises(ValueError, match="quantum"):
+        apply_overrides(cfg, {"quantum": 2**30})
+
+
+def test_fleet_uneven_lengths_and_early_finish():
+    # elements finishing chunks apart: the short element must freeze
+    # bit-exactly while the long one keeps the fleet's while_loop live
+    cfg = small_test_config(4, n_banks=4)
+    traces = [
+        synth.stream(4, n_mem_ops=4, seed=61),
+        synth.uniform_random(4, n_mem_ops=120, seed=62),
+        synth.stream(4, n_mem_ops=40, seed=63),
+    ]
+    fleet = FleetEngine(cfg, traces, chunk_steps=8)
+    fleet.run()
+    for i, t in enumerate(traces):
+        assert_element_matches_solo(fleet, i, cfg, t, chunk_steps=8)
+
+
+def test_cli_sweep(tmp_path, capsys):
+    import json
+
+    from primesim_tpu.cli import main
+    from primesim_tpu.config.machine import MachineConfig
+
+    cfg = MachineConfig(n_cores=8, n_banks=8)
+    cfg_path = str(tmp_path / "m.json")
+    with open(cfg_path, "w") as f:
+        f.write(cfg.to_json())
+    rep_dir = str(tmp_path / "reports")
+    rc = main(
+        [
+            "sweep", cfg_path,
+            "--synth", "false_sharing:n_mem_ops=30",
+            "--vary", "llc_lat=10",
+            "--vary", "llc_lat=40,dram_lat=200",
+            "--vary", "quantum=500",
+            "--chunk-steps", "32",
+            "--report-dir", rep_dir,
+        ]
+    )
+    assert rc == 0
+    lines = [
+        json.loads(ln)
+        for ln in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert len(lines) == 4  # 3 elements + aggregate
+    assert [d["detail"]["fleet_index"] for d in lines[:3]] == [0, 1, 2]
+    assert lines[3]["metric"] == "fleet_aggregate_MIPS"
+    assert lines[3]["detail"]["instructions"] == sum(
+        d["detail"]["instructions"] for d in lines[:3]
+    )
+    # element 1's slower LLC/DRAM must cost cycles vs element 0
+    assert (
+        lines[1]["detail"]["max_core_cycles"]
+        > lines[0]["detail"]["max_core_cycles"]
+    )
+    # one report per element, golden machine line reflects the override
+    import os
+
+    rep1 = open(os.path.join(rep_dir, "element_1.txt")).read()
+    assert "fleet element 1" in rep1 and "lat 40" in rep1
+
+    # each element must equal a solo CLI run of the same effective config
+    from primesim_tpu.sim.fleet import apply_overrides as ao
+
+    solo_cfg = ao(cfg, {"llc_lat": 40, "dram_lat": 200})
+    solo_path = str(tmp_path / "solo.json")
+    with open(solo_path, "w") as f:
+        f.write(solo_cfg.to_json())
+    rc = main(
+        ["run", solo_path, "--synth", "false_sharing:n_mem_ops=30"]
+    )
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert (
+        d["detail"]["max_core_cycles"]
+        == lines[1]["detail"]["max_core_cycles"]
+    )
+    assert d["detail"]["instructions"] == lines[1]["detail"]["instructions"]

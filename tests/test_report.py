@@ -1,0 +1,108 @@
+"""Direct unit tests for stats/report.py: exact golden text for a tiny
+hand-built counter set (previously only covered indirectly through the
+CLI) and the zero-total `_rate` edge case."""
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import small_test_config
+from primesim_tpu.stats.counters import COUNTER_NAMES
+from primesim_tpu.stats.report import _rate, render_report, write_report
+
+
+def _counters(C, **overrides):
+    c = {k: np.zeros(C, dtype=np.int64) for k in COUNTER_NAMES}
+    for k, v in overrides.items():
+        c[k] = np.asarray(v, dtype=np.int64)
+    return c
+
+
+def test_rate_zero_total_is_na():
+    assert _rate(0, 0) == "    n/a"
+    assert _rate(5, 0) == "    n/a"  # never divides by zero
+    assert _rate(1, 4) == " 25.00%"
+    assert _rate(3, 3) == "100.00%"
+
+
+def test_render_report_golden():
+    cfg = small_test_config(2, n_banks=2, quantum=500)
+    counters = _counters(
+        2,
+        instructions=[600, 400],
+        l1_read_hits=[30, 10],
+        l1_read_misses=[10, 0],  # core 1: no reads missed -> 100.00%
+        l1_write_hits=[0, 0],
+        l1_write_misses=[0, 0],  # core 0/1: no writes at all -> n/a
+        llc_hits=[5, 0],
+        llc_misses=[5, 0],  # core 1: no LLC accesses -> n/a
+        dram_accesses=[5, 0],
+        noc_msgs=[20, 8],
+        noc_hops=[40, 16],
+    )
+    cycles = np.array([2000, 1000], dtype=np.int64)
+    text = render_report(cfg, counters, cycles, wall_s=0.5)
+    lines = text.splitlines()
+
+    assert lines[0] == "=" * 72
+    assert lines[1] == "primesim_tpu simulation report"
+    assert "machine: 2 cores, 2 LLC banks, 2x2 mesh, quantum 500" in text
+    assert "l1: 1024B 2w lat 2 | llc/bank: 4096B 4w lat 10 | " in text
+    assert "  instructions                   1,000" in text
+    assert "  max core cycles                2,000" in text
+    # IPC = 1000 / (2000 * 2)
+    assert "  IPC (agg/core/cyc)            0.2500" in text
+    assert "  host wall seconds               0.50" in text
+    assert "  simulated MIPS                 0.002" in text
+    assert "  L1 read hit rate              80.00%" in text  # 40/50
+    assert "  L1 write hit rate                n/a" in text  # zero total
+    assert "  LLC hit rate                  50.00%" in text  # 5/10
+    assert "  DRAM accesses                      5" in text
+    assert "  NoC messages                      28" in text
+    # no sync activity -> the lock/barrier block is omitted entirely
+    assert "lock acquires" not in text
+    assert "PER-CORE (first 2 of 2)" in text
+    core_rows = [ln for ln in lines if ln.startswith("     ")]
+    assert core_rows[0] == (
+        "     0               600           2,000   0.300"
+        "   75.00%      n/a   50.00%"
+    )
+    assert core_rows[1] == (
+        "     1               400           1,000   0.400"
+        "  100.00%      n/a      n/a"
+    )
+    assert lines[-1] == "=" * 72
+    assert text.endswith("=" * 72 + "\n")
+
+
+def test_render_report_sync_block_and_limit():
+    cfg = small_test_config(4, n_banks=4)
+    counters = _counters(
+        4,
+        instructions=[100, 100, 100, 100],
+        lock_acquires=[2, 0, 0, 0],
+        lock_spins=[7, 0, 0, 0],
+        barrier_waits=[1, 1, 1, 1],
+    )
+    cycles = np.full(4, 300, dtype=np.int64)
+    text = render_report(
+        cfg, counters, cycles, per_core_limit=2, title="custom title"
+    )
+    assert "custom title" in text
+    assert "  lock acquires                      2" in text
+    assert "  lock spins                         7" in text
+    assert "  barrier waits                      4" in text
+    assert "PER-CORE (first 2 of 4)" in text
+    assert len([ln for ln in text.splitlines()
+                if ln.startswith("     ")]) == 2
+    # no wall_s -> no host-time or MIPS lines
+    assert "host wall seconds" not in text and "MIPS" not in text
+
+
+def test_write_report_roundtrip(tmp_path):
+    cfg = small_test_config(2, n_banks=2)
+    counters = _counters(2, instructions=[1, 1])
+    cycles = np.array([10, 10], dtype=np.int64)
+    p = str(tmp_path / "r.txt")
+    write_report(p, cfg, counters, cycles, title="t")
+    with open(p) as f:
+        assert f.read() == render_report(cfg, counters, cycles, title="t")

@@ -38,10 +38,17 @@ def assert_stream_matches_preloaded(cfg, trace, window_events):
             "link_free", "dram_free",  # epoch-relative like cycles
         ):
             continue
+        sv, fv = getattr(s.state, f), getattr(full.state, f)
+        if hasattr(sv, "_fields"):  # nested pytree (TimingKnobs)
+            for kf in sv._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(sv, kf)),
+                    np.asarray(getattr(fv, kf)),
+                    err_msg=f"{f}.{kf}",
+                )
+            continue
         np.testing.assert_array_equal(
-            np.asarray(getattr(s.state, f)),
-            np.asarray(getattr(full.state, f)),
-            err_msg=f,
+            np.asarray(sv), np.asarray(fv), err_msg=f
         )
     # total events consumed must equal the real per-core stream lengths
     np.testing.assert_array_equal(
