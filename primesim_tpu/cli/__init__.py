@@ -89,7 +89,10 @@ def _load_config(path: str):
         return MachineConfig.from_json(f.read())
 
 
-def _emit_summary(ns, cfg, engine_name, counters, cycles, wall, extra=None):
+def _emit_summary(
+    ns, cfg, engine_name, counters, cycles, wall, extra=None,
+    resilience=None,
+):
     """Shared one-line JSON summary + optional text report (the single
     emission contract for every engine path)."""
     from ..stats.report import write_report
@@ -119,8 +122,86 @@ def _emit_summary(ns, cfg, engine_name, counters, cycles, wall, extra=None):
         write_report(
             ns.report, cfg, counters, cycles, wall_s=wall,
             per_core_limit=ns.per_core_limit,
+            resilience=resilience,
         )
         print(f"report written to {ns.report}", file=sys.stderr)
+
+
+def _supervised(ns) -> bool:
+    """Any resilience flag engages the supervised (chunk-committed) path."""
+    return bool(
+        getattr(ns, "resume", False)
+        or getattr(ns, "checkpoint_dir", None)
+        or getattr(ns, "checkpoint_every", 0)
+        or getattr(ns, "checkpoint_wall", 0.0)
+        or getattr(ns, "guard", "off") != "off"
+    )
+
+
+def _check_supervision_flags(ns) -> None:
+    if (
+        ns.resume or ns.checkpoint_every or ns.checkpoint_wall
+    ) and not ns.checkpoint_dir:
+        raise SystemExit(
+            "--resume/--checkpoint-every/--checkpoint-wall require "
+            "--checkpoint-dir DIR (where snapshots live)"
+        )
+
+
+def _build_supervisor(ns, eng):
+    from ..sim.supervisor import RunSupervisor
+
+    return RunSupervisor(
+        eng,
+        snapshot_dir=ns.checkpoint_dir,
+        keep_snapshots=ns.keep_snapshots,
+        checkpoint_every_chunks=ns.checkpoint_every,
+        checkpoint_every_s=ns.checkpoint_wall,
+        guard=ns.guard,
+        max_retries=ns.max_retries,
+    )
+
+
+def _emit_preempted(e, sup) -> int:
+    """Preemption is a clean outcome, not a crash: report where the run
+    stopped and exit 75 (EX_TEMPFAIL — rerun with --resume)."""
+    print(f"preempted: {e}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "preempted",
+                "value": None,
+                "unit": None,
+                "detail": {
+                    "checkpoint": e.checkpoint,
+                    "signal": e.signum,
+                    **sup.summary(),
+                },
+            }
+        )
+    )
+    return 75
+
+
+def _run_supervised(ns, cfg, eng) -> int:
+    """Supervised `run` path: chunk-committed execution under a
+    RunSupervisor (auto-checkpoint, preemption, retry, guard)."""
+    from ..sim.supervisor import Preempted
+
+    sup = _build_supervisor(ns, eng)
+    if ns.resume:
+        sup.resume()
+    t0 = time.perf_counter()
+    try:
+        sup.run(max_steps=ns.max_steps)  # None -> engine-appropriate budget
+    except Preempted as e:
+        return _emit_preempted(e, sup)
+    wall = time.perf_counter() - t0
+    _emit_summary(
+        ns, cfg, ns.engine, eng.counters, eng.cycles, wall,
+        extra=sup.summary(), resilience=sup.log_lines(),
+    )
+    return 0
 
 
 def cmd_run(ns) -> int:
@@ -130,12 +211,23 @@ def cmd_run(ns) -> int:
         raise SystemExit(
             f"trace has {tr.n_cores} cores but config has {cfg.n_cores}"
         )
+    _check_supervision_flags(ns)
+    supervised = _supervised(ns)
+    if supervised and (ns.xprof or ns.debug_invariants):
+        raise SystemExit(
+            "--xprof/--debug-invariants do not compose with the supervised "
+            "path (--guard runs the same invariants post-chunk)"
+        )
 
     if ns.engine == "golden":
-        if ns.xprof or ns.debug_invariants or ns.stream_window or ns.devices:
+        if (
+            ns.xprof or ns.debug_invariants or ns.stream_window
+            or ns.devices or supervised
+        ):
             raise SystemExit(
-                "--xprof/--debug-invariants/--stream-window/--devices "
-                "require --engine jax (the golden oracle has no device loop)"
+                "--xprof/--debug-invariants/--stream-window/--devices and "
+                "the checkpoint/resume/guard flags require --engine jax "
+                "(the golden oracle has no device loop)"
             )
         from ..golden.sim import GoldenSim
 
@@ -159,6 +251,8 @@ def cmd_run(ns) -> int:
         # MIPS measures simulation, not compilation — same protocol as the
         # preloaded path above
         eng.warmup()
+        if supervised:
+            return _run_supervised(ns, cfg, eng)
         t0 = time.perf_counter()
         eng.run(max_steps=ns.max_steps)  # None -> event-count-derived
         wall = time.perf_counter() - t0
@@ -193,7 +287,9 @@ def cmd_run(ns) -> int:
         # path dispatches run_chunk, not the fused run_loop — warm the
         # function the run will actually use.
         warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
-        if ns.debug_invariants:
+        if ns.debug_invariants or supervised:
+            # the chunked paths (debug + supervised run_steps) dispatch
+            # run_chunk, not the fused run_loop — warm what will run
             out = run_chunk(
                 cfg, ns.chunk_steps, warm.events, warm.state,
                 has_sync=warm.has_sync,
@@ -207,6 +303,8 @@ def cmd_run(ns) -> int:
             np.asarray(out[0].cycles)
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
         eng.block_until_ready()  # don't bill async uploads to simulation
+        if supervised:
+            return _run_supervised(ns, cfg, eng)
 
         def _go():
             if ns.debug_invariants:
@@ -314,29 +412,42 @@ def cmd_sweep(ns) -> int:
     (sim.fleet.FleetEngine): every element shares the compiled program —
     one compilation per geometry — and the batch retires one event per
     core per element per step. Emits one JSON summary line per element
-    (ordered by fleet index) plus a fleet_aggregate_MIPS line."""
+    (ordered by fleet index) plus a fleet_aggregate_MIPS line.
+
+    Fault isolation is the default: an element whose trace file is
+    unreadable/malformed or whose overrides are invalid is QUARANTINED
+    (reported in its own JSON line, with the TraceError's core/offset
+    when available) while the rest of the batch runs; `--strict` makes
+    any bad element fatal instead."""
     import os
 
     cfg = _load_config(ns.config)
-    from ..trace.format import Trace, fold_ins
+    _check_supervision_flags(ns)
+    from ..trace.format import Trace, TraceError, fold_ins
 
-    traces = []
-    if ns.trace:
-        traces = [Trace.load(p) for p in ns.trace]
-        if ns.fold:
-            traces = [fold_ins(t) for t in traces]
+    # per-element SOURCES: callables for file loads (so an unreadable
+    # file quarantines one element, not the sweep), eager traces for
+    # synth specs (a bad spec is operator error — SystemExit above)
+    def _loader(path):
+        def load():
+            t = Trace.load(path)
+            return fold_ins(t) if ns.fold else t
+
+        return load
+
+    sources: list = [_loader(p) for p in (ns.trace or [])]
     for spec in ns.synth or []:
-        traces.append(_parse_synth(spec, cfg.n_cores, ns.fold))
-    if not traces:
+        sources.append(_parse_synth(spec, cfg.n_cores, ns.fold))
+    if not sources:
         raise SystemExit("sweep: need --trace FILE and/or --synth SPEC")
     ovs = [_parse_vary(s) for s in (ns.vary or [])]
-    A, V = len(traces), len(ovs)
+    A, V = len(sources), len(ovs)
     # fan rule: equal lengths pair up; a single trace (or single --vary)
     # replicates across the other axis; anything else is ambiguous
     if V == 0:
         ovs = [{}] * A
     elif A == 1 and V > 1:
-        traces = traces * V
+        sources = sources * V
     elif V == 1 and A > 1:
         ovs = ovs * A
     elif A != V:
@@ -349,21 +460,90 @@ def cmd_sweep(ns) -> int:
 
     import jax.numpy as jnp
 
-    from ..sim.fleet import FleetEngine, fleet_run_loop
+    from ..sim.fleet import FleetEngine, fleet_run_chunk, fleet_run_loop
+    from ..sim.supervisor import Preempted, build_fleet_isolated
 
-    fleet = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps)
+    supervised = _supervised(ns)
+    if ns.strict:
+        traces = [s() if callable(s) else s for s in sources]
+        fleet = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps)
+        quarantined: list = []
+    else:
+        fleet, quarantined = build_fleet_isolated(
+            cfg, sources, ovs, chunk_steps=ns.chunk_steps
+        )
+    for i, err in quarantined:
+        detail = {
+            "engine": "fleet",
+            "fleet_index": i,
+            "status": "quarantined",
+            "error": str(err),
+            "overrides": ovs[i],
+        }
+        if isinstance(err, TraceError):
+            detail.update(err.location())
+        print(
+            json.dumps(
+                {
+                    "metric": "quarantined",
+                    "value": None,
+                    "unit": None,
+                    "detail": detail,
+                }
+            )
+        )
+    if fleet is None:
+        print("sweep: every element was quarantined", file=sys.stderr)
+        return 1
+
     # warm the jit cache at the fleet's shapes (one chunk) — the shared
-    # protocol: reported MIPS measures simulation, not compilation
-    warm = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps)
-    out = fleet_run_loop(
-        warm.geom_cfg, warm.chunk_steps, warm.events, warm.state,
-        jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+    # protocol: reported MIPS measures simulation, not compilation. The
+    # supervised path dispatches fleet_run_chunk (chunk-committed), the
+    # fused path fleet_run_loop — warm what will run.
+    warm = FleetEngine(
+        cfg, fleet.traces, fleet.element_overrides,
+        chunk_steps=ns.chunk_steps,
     )
-    np.asarray(out[0].cycles)
+    if supervised:
+        out_st = fleet_run_chunk(
+            warm.geom_cfg, warm.chunk_steps, warm.events, warm.state,
+            has_sync=warm.has_sync,
+        )
+        np.asarray(out_st.cycles)
+    else:
+        out = fleet_run_loop(
+            warm.geom_cfg, warm.chunk_steps, warm.events, warm.state,
+            jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+        )
+        np.asarray(out[0].cycles)
     fleet.block_until_ready()
-    t0 = time.perf_counter()
-    fleet.run(max_steps=ns.max_steps or 10_000_000)
-    wall = time.perf_counter() - t0
+    stalled: list[int] = []
+    if supervised:
+        sup = _build_supervisor(ns, fleet)
+        if ns.resume:
+            sup.resume()
+        t0 = time.perf_counter()
+        try:
+            sup.run(max_steps=ns.max_steps or 10_000_000)
+        except Preempted as e:
+            return _emit_preempted(e, sup)
+        wall = time.perf_counter() - t0
+        stalled = list(sup.stalled_elements)
+        for line in sup.log_lines():
+            print(f"supervisor: {line}", file=sys.stderr)
+    else:
+        t0 = time.perf_counter()
+        try:
+            fleet.run(max_steps=ns.max_steps or 10_000_000)
+        except RuntimeError as e:
+            # deadlocked/budget-stalled elements are isolated, same as
+            # quarantine: report them, keep the finished elements' results
+            stalled = [
+                fleet.element_ids[j]
+                for j in np.flatnonzero(~fleet.done_mask())
+            ]
+            print(f"sweep: {e} — isolating", file=sys.stderr)
+        wall = time.perf_counter() - t0
 
     from ..stats.report import write_report
 
@@ -372,50 +552,59 @@ def cmd_sweep(ns) -> int:
     if ns.report_dir:
         os.makedirs(ns.report_dir, exist_ok=True)
     total_ins = 0
-    for i in range(fleet.n_elements):
-        ec = {k: v[i] for k, v in counters.items()}
+    for j in range(fleet.n_elements):
+        i = fleet.element_ids[j]  # caller-side index (quarantine-stable)
+        ec = {k: v[j] for k, v in counters.items()}
         ins = int(ec["instructions"].sum())
         total_ins += ins
+        detail = {
+            "engine": "fleet",
+            "fleet_index": i,
+            "n_cores": cfg.n_cores,
+            "instructions": ins,
+            "max_core_cycles": int(cycles[j].max()),
+            "overrides": ovs[i],
+            "wall_s": round(wall, 3),
+            "noc_msgs": int(ec["noc_msgs"].sum()),
+        }
+        if i in stalled:
+            detail["status"] = "stalled"
         print(
             json.dumps(
                 {
                     "metric": "simulated_MIPS",
                     "value": round(ins / wall / 1e6, 3),
                     "unit": "MIPS",
-                    "detail": {
-                        "engine": "fleet",
-                        "fleet_index": i,
-                        "n_cores": cfg.n_cores,
-                        "instructions": ins,
-                        "max_core_cycles": int(cycles[i].max()),
-                        "overrides": ovs[i],
-                        "wall_s": round(wall, 3),
-                        "noc_msgs": int(ec["noc_msgs"].sum()),
-                    },
+                    "detail": detail,
                 }
             )
         )
         if ns.report_dir:
             path = os.path.join(ns.report_dir, f"element_{i}.txt")
             write_report(
-                path, fleet.elem_cfgs[i], ec, cycles[i], wall_s=wall,
+                path, fleet.elem_cfgs[j], ec, cycles[j], wall_s=wall,
                 per_core_limit=ns.per_core_limit,
                 title=f"primesim_tpu fleet element {i}",
             )
             print(f"report written to {path}", file=sys.stderr)
+    agg_detail = {
+        "engine": "fleet",
+        "n_elements": fleet.n_elements,
+        "n_cores": cfg.n_cores,
+        "instructions": total_ins,
+        "wall_s": round(wall, 3),
+    }
+    if quarantined:
+        agg_detail["quarantined"] = [i for i, _ in quarantined]
+    if stalled:
+        agg_detail["stalled"] = stalled
     print(
         json.dumps(
             {
                 "metric": "fleet_aggregate_MIPS",
                 "value": round(total_ins / wall / 1e6, 3),
                 "unit": "MIPS",
-                "detail": {
-                    "engine": "fleet",
-                    "n_elements": fleet.n_elements,
-                    "n_cores": cfg.n_cores,
-                    "instructions": total_ins,
-                    "wall_s": round(wall, 3),
-                },
+                "detail": agg_detail,
             }
         )
     )
@@ -436,6 +625,47 @@ def cmd_synth(ns) -> int:
 def cmd_info(ns) -> int:
     print(_load_config(ns.config).to_json())
     return 0
+
+
+def _add_resilience_flags(sp) -> None:
+    """Shared run/sweep resilience surface (DESIGN.md §10): any of these
+    flags switches the command onto the supervised chunk-committed path
+    (sim.supervisor.RunSupervisor) — results stay bit-exact."""
+    sp.add_argument(
+        "--checkpoint-dir", metavar="DIR",
+        help="rotating-snapshot directory (ckpt-<seq>.npz, atomic + "
+             "CRC-verified); enables checkpointing and --resume",
+    )
+    sp.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="K",
+        help="checkpoint every K committed chunks (needs --checkpoint-dir)",
+    )
+    sp.add_argument(
+        "--checkpoint-wall", type=float, default=0.0, metavar="SEC",
+        help="checkpoint when SEC wall-seconds passed since the last one "
+             "(needs --checkpoint-dir; combines with --checkpoint-every)",
+    )
+    sp.add_argument(
+        "--keep-snapshots", type=int, default=3, metavar="N",
+        help="rotating snapshots retained in --checkpoint-dir (default 3)",
+    )
+    sp.add_argument(
+        "--resume", action="store_true",
+        help="restore the newest VALID snapshot from --checkpoint-dir "
+             "(corrupt ones are skipped; config+trace fingerprints are "
+             "verified) and continue — bit-exact with an uninterrupted run",
+    )
+    sp.add_argument(
+        "--guard", choices=("off", "warn", "fail"), default="off",
+        help="post-chunk invariant guard (MESI/directory consistency, "
+             "clock window, monotone counters): warn logs violations, "
+             "fail stops BEFORE checkpointing the bad state",
+    )
+    sp.add_argument(
+        "--max-retries", type=int, default=4, metavar="N",
+        help="retries per chunk on transient device failures (exponential "
+             "backoff; OOM halves chunk_steps; last resort: CPU backend)",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -490,6 +720,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="shard the simulated machine over the first N jax devices "
              "(cores/L1s by core, LLC/directory by bank; jax engine)",
     )
+    _add_resilience_flags(r)
     r.set_defaults(fn=cmd_run)
 
     w = sub.add_parser(
@@ -521,6 +752,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--report-dir", help="write per-element text reports to this directory"
     )
     w.add_argument("--per-core-limit", type=int, default=64)
+    w.add_argument(
+        "--strict", action="store_true",
+        help="disable fleet fault isolation: any malformed element "
+             "(unreadable trace, bad overrides) aborts the whole sweep "
+             "instead of being quarantined into its own JSON line",
+    )
+    _add_resilience_flags(w)
     w.set_defaults(fn=cmd_sweep)
 
     c = sub.add_parser(

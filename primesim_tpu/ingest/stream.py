@@ -29,7 +29,13 @@ from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES, zero_counters
 from ..sim.engine import _ACC_BITS, stream_loop
 from ..sim.state import init_state
-from ..trace.format import EV_END, Trace, scan_trace_meta
+from ..trace.format import (
+    EV_BARRIER,
+    EV_END,
+    Trace,
+    TraceError,
+    scan_trace_meta,
+)
 
 
 def absorb_stream_outputs(eng, out, buf):
@@ -94,8 +100,10 @@ class StreamEngine:
             trace, cfg.barrier_slots
         )
         if bad_bid:
-            raise ValueError(
-                f"trace uses barrier ids >= barrier_slots={cfg.barrier_slots}"
+            raise TraceError(
+                f"trace uses barrier ids >= barrier_slots={cfg.barrier_slots}",
+                core=bad_bid[0],
+                offset=bad_bid[1],
             )
         # real (pre-END) event count per core
         self.real_len = np.asarray(trace.lengths, dtype=np.int64) - 1
@@ -193,6 +201,28 @@ class StreamEngine:
 
     def _default_budget(self) -> int:
         return max(10_000_000, 64 * int(self.real_len.sum()))
+
+    def done(self) -> bool:
+        """All cores consumed their real (pre-END) events."""
+        return bool((self.cursor >= self.real_len).all())
+
+    def done_mask(self) -> np.ndarray:
+        """Per-core finished mask (host-side, from the stream cursors)."""
+        return self.cursor >= self.real_len
+
+    def live_mask(self) -> np.ndarray:
+        """Cores that bound the quantum window at this cut: not finished
+        and not frozen at a barrier (frozen clocks legally lag
+        quantum_end until release). Supervisor guard input — same
+        contract as Engine.live_mask, but read from host cursors into
+        the (possibly mmapped) source instead of a device ptr gather."""
+        C = self.cfg.n_cores
+        at = np.minimum(self.cursor, np.maximum(self.real_len - 1, 0))
+        et = np.asarray(self.src[np.arange(C), at, 0])
+        frozen = (et == EV_BARRIER) & (
+            np.asarray(self.state.sync_flag) != 0
+        )
+        return (self.cursor < self.real_len) & ~frozen
 
     def run(self, max_steps: int | None = None) -> None:
         """Stream to completion. `max_steps` defaults to a budget derived

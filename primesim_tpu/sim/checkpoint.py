@@ -11,12 +11,21 @@ any step boundary is a consistent cut.
 Bit-exactness contract: run(A+B steps) == run(A) -> save -> load -> run(B),
 for cycles, counters, and all cache/directory/sync state
 (tests/test_checkpoint.py).
+
+Durability contract (DESIGN.md §10): every save goes through
+`atomic_save_npz` — write to `<path>.tmp`, fsync, `os.replace` — so a
+crash mid-write can never replace a good snapshot with a torn one, and a
+per-array CRC32 manifest inside the npz turns silent media corruption
+into a typed `CheckpointCorrupt` at load time (which the supervisor's
+snapshot rotation treats as "fall back to the next-newest valid one").
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import os
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -29,6 +38,98 @@ _FORMAT = 4  # v3: fused dirm row (metadata + sharers) replaces
 # llc_meta/sharers; 5-plane l1; link_free/dram_free queue clocks.
 # v4: nested TimingKnobs state field (flattened to state_knobs__<name>
 # keys — npz holds flat arrays only).
+
+_CRC_KEY = "crc_json"  # reserved npz member: {array name: crc32} manifest
+
+
+class CheckpointCorrupt(ValueError):
+    """The checkpoint file is torn, truncated, or fails CRC verification.
+
+    Distinct from the plain ValueErrors the loaders raise for MISMATCHED
+    checkpoints (wrong config/trace/kind): a mismatch means the caller
+    pointed a healthy snapshot at the wrong engine and retrying another
+    snapshot would silently resume the wrong run, while corruption means
+    THIS file is unusable and an older snapshot is the right fallback.
+    The supervisor's rotation logic relies on that distinction."""
+
+
+def atomic_save_npz(path: str, **arrays) -> None:
+    """Write an npz atomically with per-array CRC32s.
+
+    The bytes go to `<path>.tmp` first, are flushed and fsynced, and
+    only then `os.replace`d over `path` — so `path` always holds either
+    the previous complete snapshot or the new complete snapshot, never a
+    torn hybrid (the POSIX rename-is-atomic contract). A `crc_json`
+    member maps every array name to the CRC32 of its contiguous bytes;
+    `load_verified_npz` recomputes and compares before any array is
+    trusted."""
+    named = {k: np.asarray(v) for k, v in arrays.items()}
+    if _CRC_KEY in named:
+        raise ValueError(f"array name {_CRC_KEY!r} is reserved")
+    crcs = {
+        k: zlib.crc32(np.ascontiguousarray(v).tobytes())
+        for k, v in named.items()
+    }
+    named[_CRC_KEY] = np.frombuffer(
+        json.dumps(crcs, sort_keys=True).encode(), dtype=np.uint8
+    )
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **named)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # fsync the directory so the rename itself survives power loss
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def load_verified_npz(path: str) -> dict[str, np.ndarray]:
+    """Load an npz fully into host memory, verifying the CRC manifest.
+
+    Any read/decode failure (missing file is the exception — that stays
+    FileNotFoundError so "no snapshot yet" and "bad snapshot" remain
+    distinguishable) and any CRC mismatch raises CheckpointCorrupt.
+    Files written before the manifest existed (no `crc_json`) load
+    unverified — zipfile's own member CRCs still catch torn writes."""
+    try:
+        with np.load(path) as z:
+            data = {k: np.asarray(z[k]) for k in z.files}
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"{path}: unreadable checkpoint ({type(e).__name__}: {e})"
+        ) from e
+    if _CRC_KEY in data:
+        try:
+            crcs = json.loads(bytes(data.pop(_CRC_KEY)).decode())
+        except Exception as e:
+            raise CheckpointCorrupt(
+                f"{path}: unreadable CRC manifest ({e})"
+            ) from e
+        for k, want in crcs.items():
+            if k not in data:
+                raise CheckpointCorrupt(
+                    f"{path}: array {k!r} in CRC manifest is missing"
+                )
+            got = zlib.crc32(np.ascontiguousarray(data[k]).tobytes())
+            if got != int(want):
+                raise CheckpointCorrupt(
+                    f"{path}: array {k!r} fails CRC32 "
+                    f"(stored {int(want)}, recomputed {got})"
+                )
+    return data
 
 
 def _state_arrays(st: MachineState) -> dict[str, np.ndarray]:
@@ -80,7 +181,7 @@ def save_checkpoint(path: str, engine) -> None:
     arrays["host_counters"] = np.stack(
         [engine.host_counters[k] for k in COUNTER_NAMES]
     )
-    np.savez_compressed(
+    atomic_save_npz(
         path,
         format=np.int64(_FORMAT),
         cycle_base=np.int64(engine.cycle_base),
@@ -105,7 +206,7 @@ def save_stream_checkpoint(path: str, eng) -> None:
     arrays["host_counters"] = np.stack(
         [eng.host_counters[k] for k in COUNTER_NAMES]
     )
-    np.savez_compressed(
+    atomic_save_npz(
         path,
         format=np.int64(_FORMAT),
         stream=np.int64(1),
@@ -126,7 +227,7 @@ def load_stream_checkpoint(path: str, eng) -> None:
     the same config + trace (fingerprint-validated). Resuming then
     re-fills the window from the restored cursors — bit-exact with an
     uninterrupted run (tests/test_checkpoint.py)."""
-    z = np.load(path)
+    z = load_verified_npz(path)
     if int(z["format"]) != _FORMAT or "stream" not in z:
         raise ValueError(f"{path}: not a compatible streaming checkpoint")
     if MachineConfig.from_json(bytes(z["config_json"]).decode()) != eng.cfg:
@@ -154,7 +255,7 @@ def load_checkpoint(path: str, engine) -> None:
     The engine must have been built with the same MachineConfig and Trace
     the checkpoint was taken under (validated by fingerprint).
     """
-    z = np.load(path)
+    z = load_verified_npz(path)
     if int(z["format"]) != _FORMAT:
         raise ValueError(f"{path}: unsupported checkpoint format {int(z['format'])}")
     if "stream" in z:
@@ -203,7 +304,7 @@ def save_fleet_checkpoint(path: str, fleet) -> None:
     arrays["host_counters"] = np.stack(
         [fleet.host_counters[k] for k in COUNTER_NAMES]
     )  # [n_counters, B, C]
-    np.savez_compressed(
+    atomic_save_npz(
         path,
         format=np.int64(_FORMAT),
         fleet=np.int64(1),
@@ -228,7 +329,7 @@ def load_fleet_checkpoint(path: str, fleet) -> None:
     same per-element (config, trace) list — order included (the batch
     axis is positional). Resuming is bit-exact per element
     (tests/test_checkpoint.py)."""
-    z = np.load(path)
+    z = load_verified_npz(path)
     if int(z["format"]) != _FORMAT or "fleet" not in z:
         raise ValueError(f"{path}: not a compatible fleet checkpoint")
     cfgs = [

@@ -1743,6 +1743,20 @@ class Engine:
     def done(self) -> bool:
         return bool((self._event_types_at_ptr() == EV_END).all())
 
+    def done_mask(self) -> np.ndarray:
+        """[C] bool — cores whose trace pointer sits on END."""
+        return self._event_types_at_ptr() == EV_END
+
+    def live_mask(self) -> np.ndarray:
+        """[C] bool — cores that bound the quantum window: not at END and
+        not frozen at a barrier (a frozen core's clock legally lags
+        `quantum_end` until release, mirroring the `countable` mask in
+        step() phase 0). Input to the supervisor's clock-window guard
+        (validate.check_chunk_invariants)."""
+        et = self._event_types_at_ptr()
+        frozen = (et == EV_BARRIER) & (_np(self.state.sync_flag) != 0)
+        return (et != EV_END) & ~frozen
+
     def run(self, max_steps: int = 10_000_000) -> None:
         """Run to completion in ONE device dispatch (preferred path).
 

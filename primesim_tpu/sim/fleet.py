@@ -255,6 +255,11 @@ class FleetEngine:
             k: np.zeros((B, C), np.int64) for k in COUNTER_NAMES
         }
         self.steps_run = np.zeros(B, np.int64)
+        # original (caller-side) index of each batch position; the fault
+        # isolation builder (sim.supervisor.build_fleet_isolated) rewrites
+        # this after quarantining elements so reports keep caller indices
+        self.element_ids = list(range(B))
+        self.element_overrides = [dict(ov) for ov in overrides]
 
     # ---- batched bookkeeping (Engine's host helpers, vectorized) ---------
 
@@ -284,6 +289,18 @@ class FleetEngine:
 
     def done(self) -> bool:
         return bool(self.done_mask().all())
+
+    def core_done_mask(self) -> np.ndarray:
+        """[B, C] bool — per-element per-core END mask (guard input)."""
+        return self._event_types_at_ptr() == EV_END
+
+    def live_mask(self) -> np.ndarray:
+        """[B, C] bool — cores bounding each element's quantum window:
+        not at END, not frozen at a barrier (same contract as
+        Engine.live_mask, batched)."""
+        et = self._event_types_at_ptr()
+        frozen = (et == EV_BARRIER) & (_np(self.state.sync_flag) != 0)
+        return (et != EV_END) & ~frozen
 
     def _rebase(self) -> None:
         """Per-element host rebase (run_steps path; `run` rebases on

@@ -1,0 +1,635 @@
+"""Resilient execution layer — RunSupervisor (DESIGN.md §10).
+
+PriME's value is long campaigns: thousand-core configs and parameter
+sweeps that run for hours. At that scale the limiting factor is not peak
+MIPS but surviving the failures the fleet WILL throw at a long run —
+preemption (TPU pods are preemptible by default), device OOM on an
+over-ambitious chunk size, transient runtime errors, corrupt input
+traces in a thousand-element sweep, and torn checkpoint files from the
+previous crash. `RunSupervisor` wraps any of the three engines (solo
+`Engine`, windowed `StreamEngine`, batched `FleetEngine`) and drives it
+chunk by committed chunk with:
+
+- **rotating atomic snapshots** — `ckpt-<seq>.npz` files written through
+  `checkpoint.atomic_save_npz` (tmp + fsync + `os.replace`, per-array
+  CRC32 manifest); `resume()` walks them newest-first and falls back
+  past any that raise `CheckpointCorrupt`, so one torn file never
+  strands a run. Cadence: every K committed chunks and/or W
+  wall-seconds.
+- **preemption handling** — SIGTERM/SIGINT set a flag; at the next
+  committed chunk boundary the supervisor checkpoints and raises
+  `Preempted`. The engine's chunk boundary is already a consistent cut,
+  so the resumed run is bit-exact with an uninterrupted one
+  (tests/test_supervisor.py).
+- **retry with exponential backoff + graceful degradation** — failures
+  whose text carries a transient gRPC-style status (UNAVAILABLE,
+  DEADLINE_EXCEEDED, ...) are retried with doubling backoff; OOM
+  (RESOURCE_EXHAUSTED) first halves `chunk_steps` (chunking only
+  changes the drain/rebase cadence, never results); after
+  `max_retries` the supervisor tries moving the run to the CPU backend
+  once before giving up. Every decision lands in the run log
+  (`log_lines()`, rendered into the report).
+- **post-chunk invariant guard** — `--guard=off|warn|fail` runs
+  `validate.check_chunk_invariants` (MESI/directory consistency, clock
+  window, monotone counters) on every committed chunk.
+- **fleet fault isolation** — `build_fleet_isolated` validates every
+  element (trace loadable, core count, overrides, barrier ids) BEFORE
+  batching and quarantines bad ones with their typed error, so one
+  malformed element costs one JSON line, not the whole sweep.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import time
+
+import numpy as np
+
+from ..stats.counters import COUNTER_NAMES
+from .checkpoint import CheckpointCorrupt
+from .validate import check_chunk_invariants
+
+
+class Preempted(RuntimeError):
+    """A SIGTERM/SIGINT arrived mid-run; the supervisor committed the
+    current chunk, wrote a snapshot (`.checkpoint`, None when no
+    snapshot dir was configured), and stopped cleanly. Rerun with
+    `--resume` to continue bit-exactly."""
+
+    def __init__(self, message: str, checkpoint: str | None = None,
+                 signum: int | None = None):
+        super().__init__(message)
+        self.checkpoint = checkpoint
+        self.signum = signum
+
+
+class GuardViolation(RuntimeError):
+    """`--guard=fail`: a post-chunk invariant check failed. The run
+    stopped BEFORE checkpointing the bad state — the newest snapshot
+    predates the violation."""
+
+
+# Failure classification is textual by design: the JAX runtime surfaces
+# device errors as XlaRuntimeError (jaxlib version-dependent import
+# path) whose message embeds the gRPC-style status name.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "INTERNAL",
+    "CANCELLED",
+    "failed to connect",
+    "Socket closed",
+)
+
+
+def classify_failure(exc: BaseException) -> str | None:
+    """'oom' | 'transient' | None (permanent) for an engine dispatch
+    failure. Deliberate errors (ValueError config/trace mismatches,
+    AssertionError invariants, KeyboardInterrupt) are never retried."""
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, AssertionError,
+                        ValueError)):
+        return None
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in text for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return None
+
+
+_SNAP_RE = re.compile(r"ckpt-(\d{8})\.npz")
+
+
+class SnapshotStore:
+    """Rotating checkpoint directory: `ckpt-<seq:08d>.npz`, newest wins,
+    oldest pruned past `keep`. Sequence numbers only grow (they restart
+    from the newest surviving file on resume), so "latest" is a pure
+    filename sort — no mtime trust."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.dir = str(directory)
+        self.keep = max(1, int(keep))
+        os.makedirs(self.dir, exist_ok=True)
+
+    def snapshots(self) -> list[str]:
+        """Snapshot paths, newest (highest sequence) first."""
+        found = []
+        for name in os.listdir(self.dir):
+            m = _SNAP_RE.fullmatch(name)
+            if m:
+                found.append((int(m.group(1)), os.path.join(self.dir, name)))
+        return [p for _, p in sorted(found, reverse=True)]
+
+    def save(self, save_fn) -> str:
+        """Write the next snapshot via `save_fn(path)` (the engines'
+        `save_checkpoint`, already atomic), then prune."""
+        snaps = self.snapshots()
+        seq = (
+            int(_SNAP_RE.fullmatch(os.path.basename(snaps[0])).group(1)) + 1
+            if snaps
+            else 1
+        )
+        path = os.path.join(self.dir, f"ckpt-{seq:08d}.npz")
+        save_fn(path)
+        for p in self.snapshots()[self.keep:]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        return path
+
+
+class RunSupervisor:
+    """Drive an engine to completion chunk by chunk, surviving what the
+    fused `run()` paths cannot (module docstring). The wrapped engine is
+    advanced through its own public stepping surface (`run_steps` /
+    `_advance_window`), so supervised results are bit-exact with
+    unsupervised ones — supervision changes WHEN work is committed,
+    never what is computed.
+
+    `on_chunk(supervisor)` fires after every committed chunk, before the
+    guard/preemption checks — the deterministic injection point the
+    crash-recovery tests use (`os.kill` from the callback lands the
+    signal at an exact chunk boundary)."""
+
+    def __init__(
+        self,
+        engine,
+        snapshot_dir: str | None = None,
+        keep_snapshots: int = 3,
+        checkpoint_every_chunks: int = 0,
+        checkpoint_every_s: float = 0.0,
+        guard: str = "off",
+        max_retries: int = 4,
+        backoff_s: float = 0.5,
+        handle_signals: bool = True,
+        on_chunk=None,
+    ):
+        if guard not in ("off", "warn", "fail"):
+            raise ValueError(f"guard must be off|warn|fail, got {guard!r}")
+        self.engine = engine
+        self.kind = (
+            "stream"
+            if hasattr(engine, "_advance_window")
+            else "fleet" if hasattr(engine, "elem_cfgs") else "solo"
+        )
+        self.store = (
+            SnapshotStore(snapshot_dir, keep_snapshots)
+            if snapshot_dir
+            else None
+        )
+        self.checkpoint_every_chunks = int(checkpoint_every_chunks)
+        self.checkpoint_every_s = float(checkpoint_every_s)
+        self.guard = guard
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.handle_signals = handle_signals
+        self.on_chunk = on_chunk
+        self.committed = 0  # chunks committed under this supervisor
+        self.retries = 0
+        self.guard_warnings = 0
+        self.checkpoints_written = 0
+        self.resumed_from: str | None = None
+        self.stalled_elements: list[int] = []  # fleet: budget-exhausted
+        self._events_log: list[tuple[float, str, str]] = []
+        self._t0 = time.monotonic()
+        self._preempt: int | None = None
+        self._prev_handlers: dict = {}
+        self._prev_totals: dict[str, int] | None = None
+        self._cpu_fallback_done = False
+        self._stream_finished = False
+
+    # ---- logging --------------------------------------------------------
+
+    def _log(self, kind: str, msg: str) -> None:
+        self._events_log.append((time.monotonic() - self._t0, kind, msg))
+
+    def log_lines(self) -> list[str]:
+        """Human-readable supervision log (rendered into the report)."""
+        return [
+            f"[+{t:7.1f}s] {kind}: {msg}" for t, kind, msg in self._events_log
+        ]
+
+    def summary(self) -> dict:
+        return {
+            "supervised": True,
+            "committed_chunks": self.committed,
+            "checkpoints_written": self.checkpoints_written,
+            "resumed_from": self.resumed_from,
+            "retries": self.retries,
+            "guard": self.guard,
+            "guard_warnings": self.guard_warnings,
+            "stalled_elements": self.stalled_elements,
+        }
+
+    # ---- snapshots ------------------------------------------------------
+
+    def checkpoint(self) -> str | None:
+        """Write the next rotating snapshot (None without a store)."""
+        if self.store is None:
+            return None
+        path = self.store.save(self.engine.save_checkpoint)
+        self.checkpoints_written += 1
+        self._log("checkpoint", os.path.basename(path))
+        return path
+
+    def resume(self) -> str | None:
+        """Restore the newest VALID snapshot into the engine.
+
+        Corrupt snapshots (torn write, failed CRC) are skipped with a
+        log entry and the next-newest is tried; config/trace mismatches
+        are real errors and propagate (resuming the wrong run silently
+        is worse than dying). Returns the restored path, or None when
+        the directory holds no snapshots (fresh start)."""
+        if self.store is None:
+            raise ValueError("resume() requires a snapshot_dir")
+        snaps = self.store.snapshots()
+        if not snaps:
+            self._log("resume", "no snapshots found; starting fresh")
+            return None
+        for path in snaps:
+            try:
+                self.engine.load_checkpoint(path)
+            except CheckpointCorrupt as e:
+                self._log(
+                    "resume-skip",
+                    f"{os.path.basename(path)} invalid, trying older ({e})",
+                )
+                continue
+            self.resumed_from = path
+            self._log("resume", f"resumed from {os.path.basename(path)}")
+            return path
+        raise CheckpointCorrupt(
+            f"{self.store.dir}: all {len(snaps)} snapshots are corrupt"
+        )
+
+    # ---- signals --------------------------------------------------------
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._preempt is not None:
+            # second signal: the operator is insisting — die now
+            raise KeyboardInterrupt
+        self._preempt = signum
+
+    def _install_signals(self) -> None:
+        if not self.handle_signals:
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:  # not the main thread
+                pass
+
+    def _restore_signals(self) -> None:
+        for sig, h in self._prev_handlers.items():
+            signal.signal(sig, h)
+        self._prev_handlers = {}
+
+    # ---- engine surface (kind dispatch) ---------------------------------
+
+    def _done(self) -> bool:
+        if self.kind == "stream":
+            return self._stream_finished or self.engine.done()
+        return self.engine.done()
+
+    def _steps_used(self) -> int:
+        if self.kind == "fleet":
+            return int(self.engine.steps_run.max())
+        return int(self.engine.steps_run)
+
+    def _counter_totals(self) -> dict[str, int]:
+        return {
+            k: int(np.asarray(v).sum())
+            for k, v in self.engine.host_counters.items()
+        }
+
+    def _host_snapshot(self) -> dict:
+        """References/copies of everything `_advance_chunk` mutates, so a
+        failed dispatch can be rolled back before a retry (the device
+        computation is functional; only these host fields move)."""
+        eng = self.engine
+        snap = {
+            "state": eng.state,
+            "steps_run": (
+                eng.steps_run.copy()
+                if isinstance(eng.steps_run, np.ndarray)
+                else eng.steps_run
+            ),
+            "cycle_base": (
+                eng.cycle_base.copy()
+                if isinstance(eng.cycle_base, np.ndarray)
+                else eng.cycle_base
+            ),
+            "host_counters": {k: v.copy() for k, v in eng.host_counters.items()},
+        }
+        if self.kind == "stream":
+            snap["cursor"] = eng.cursor.copy()
+        return snap
+
+    def _host_restore(self, snap: dict) -> None:
+        eng = self.engine
+        eng.state = snap["state"]
+        eng.steps_run = snap["steps_run"]
+        eng.cycle_base = snap["cycle_base"]
+        eng.host_counters = snap["host_counters"]
+        if self.kind == "stream":
+            eng.cursor = snap["cursor"]
+
+    def _advance_chunk(self, budget_left: int) -> int:
+        """Advance the engine by one committed chunk; returns steps run
+        (stream reports the device loop's count; solo/fleet report their
+        chunk size)."""
+        if self.kind == "stream":
+            k, finished = self.engine._advance_window(budget_left)
+            self._stream_finished = finished
+            return k
+        before = self._steps_used()
+        self.engine.run_steps(self.engine.chunk_steps)
+        return self._steps_used() - before
+
+    # ---- retry / degradation --------------------------------------------
+
+    def _fallback_to_cpu(self, cause: BaseException) -> bool:
+        """Last-resort degradation: move the run to the CPU backend.
+        Returns False when impossible (already on CPU, mesh-sharded, or
+        no CPU devices) — the caller then re-raises the original."""
+        import jax
+
+        if self._cpu_fallback_done:
+            return False
+        if getattr(self.engine, "mesh", None) is not None:
+            self._log(
+                "degrade", "cannot fall back to CPU: engine is mesh-sharded"
+            )
+            return False
+        if jax.default_backend() == "cpu":
+            return False
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            return False
+        self._log("degrade", f"moving run to CPU backend after: {cause}")
+        jax.config.update("jax_default_device", cpu)
+        for attr in ("events", "state"):
+            if hasattr(self.engine, attr):
+                setattr(
+                    self.engine,
+                    attr,
+                    jax.device_put(getattr(self.engine, attr), cpu),
+                )
+        self._cpu_fallback_done = True
+        return True
+
+    def _advance_with_retry(self, budget_left: int) -> int:
+        attempt = 0
+        delay = self.backoff_s
+        while True:
+            snap = self._host_snapshot()
+            try:
+                return self._advance_chunk(budget_left)
+            except Exception as e:
+                self._host_restore(snap)
+                kind = classify_failure(e)
+                if kind is None:
+                    raise
+                if attempt >= self.max_retries:
+                    if self._fallback_to_cpu(e):
+                        continue  # one full attempt on the CPU backend
+                    self._log(
+                        "give-up",
+                        f"{kind} failure persisted after "
+                        f"{self.max_retries} retries: {e}",
+                    )
+                    raise
+                attempt += 1
+                self.retries += 1
+                chunk = getattr(self.engine, "chunk_steps", 1)
+                if kind == "oom" and chunk > 1:
+                    # halving only changes the drain/rebase cadence, so
+                    # results stay bit-exact; recompile is the cost
+                    self.engine.chunk_steps = max(1, chunk // 2)
+                    self._log(
+                        "degrade",
+                        f"device OOM: chunk_steps {chunk} -> "
+                        f"{self.engine.chunk_steps}, retrying "
+                        f"(attempt {attempt}/{self.max_retries})",
+                    )
+                else:
+                    self._log(
+                        "retry",
+                        f"transient failure ({e}); backing off "
+                        f"{delay:.2f}s (attempt {attempt}/"
+                        f"{self.max_retries})",
+                    )
+                    time.sleep(delay)
+                    delay = min(delay * 2, 30.0)
+
+    # ---- guard ----------------------------------------------------------
+
+    def _guard_check(self) -> None:
+        if self.guard == "off":
+            return
+        totals = self._counter_totals()
+        try:
+            if self.kind == "fleet":
+                core_done = self.engine.core_done_mask()
+                live = self.engine.live_mask()
+                for i, cfg in enumerate(self.engine.elem_cfgs):
+                    check_chunk_invariants(
+                        cfg,
+                        self.engine.element_state(i),
+                        done_mask=core_done[i],
+                        live_mask=live[i],
+                    )
+                check_chunk_invariants(
+                    self.engine.cfg,
+                    None,
+                    prev_totals=self._prev_totals,
+                    totals=totals,
+                )
+            else:
+                check_chunk_invariants(
+                    self.engine.cfg,
+                    self.engine.state,
+                    done_mask=self.engine.done_mask(),
+                    live_mask=self.engine.live_mask(),
+                    prev_totals=self._prev_totals,
+                    totals=totals,
+                )
+        except AssertionError as e:
+            if self.guard == "warn":
+                self.guard_warnings += 1
+                self._log("guard-warn", str(e))
+            else:
+                self._log("guard-fail", str(e))
+                raise GuardViolation(str(e)) from e
+        self._prev_totals = totals
+
+    # ---- the supervised loop --------------------------------------------
+
+    def run(self, max_steps: int | None = None) -> None:
+        """Run the engine to completion under supervision.
+
+        Raises Preempted (after checkpointing) on SIGTERM/SIGINT,
+        GuardViolation under `--guard=fail`, RuntimeError when the step
+        budget runs out with cores still live (fleet: budget-stalled
+        elements are recorded in `stalled_elements` and reported instead
+        — one deadlocked element must not void the batch)."""
+        if max_steps is None:
+            max_steps = (
+                self.engine._default_budget()
+                if self.kind == "stream"
+                else 10_000_000
+            )
+        budget_left = int(max_steps)
+        start_steps = self._steps_used()
+        self._install_signals()
+        self._prev_totals = self._counter_totals()
+        last_ckpt_t = time.monotonic()
+        chunks_since_ckpt = 0
+        try:
+            while not self._done():
+                if self.kind == "stream":
+                    stepped = self._advance_with_retry(budget_left)
+                    budget_left -= stepped
+                else:
+                    stepped = self._advance_with_retry(0)
+                self.committed += 1
+                chunks_since_ckpt += 1
+                if self.on_chunk is not None:
+                    self.on_chunk(self)
+                self._guard_check()
+                if self._preempt is not None:
+                    signum = self._preempt
+                    path = self.checkpoint()
+                    name = signal.Signals(signum).name
+                    where = (
+                        f"snapshot {os.path.basename(path)}"
+                        if path
+                        else "no snapshot dir configured"
+                    )
+                    self._log("preempt", f"{name} at chunk boundary; {where}")
+                    raise Preempted(
+                        f"preempted by {name} after {self.committed} "
+                        f"committed chunks ({where})",
+                        checkpoint=path,
+                        signum=signum,
+                    )
+                now = time.monotonic()
+                if self.store is not None and (
+                    (
+                        self.checkpoint_every_chunks > 0
+                        and chunks_since_ckpt >= self.checkpoint_every_chunks
+                    )
+                    or (
+                        self.checkpoint_every_s > 0
+                        and now - last_ckpt_t >= self.checkpoint_every_s
+                    )
+                ):
+                    self.checkpoint()
+                    chunks_since_ckpt = 0
+                    last_ckpt_t = now
+                if self.kind != "stream":
+                    if stepped == 0 or (
+                        self._steps_used() - start_steps >= max_steps
+                        and not self._done()
+                    ):
+                        if self.kind == "fleet":
+                            self.stalled_elements = [
+                                self.engine.element_ids[j]
+                                for j in np.flatnonzero(
+                                    ~self.engine.done_mask()
+                                )
+                            ]
+                            self._log(
+                                "stall",
+                                f"step budget exhausted; elements "
+                                f"{self.stalled_elements} still live — "
+                                "isolating, rest of the batch is complete",
+                            )
+                            break
+                        raise RuntimeError(
+                            f"supervised run: step budget ({max_steps}) "
+                            "exhausted with cores still live (deadlock?)"
+                        )
+                elif budget_left <= 0 and not self._done():
+                    raise RuntimeError(
+                        f"supervised run: step budget ({max_steps}) "
+                        "exhausted with the stream unfinished"
+                    )
+            if self.store is not None:
+                self.checkpoint()  # final snapshot: resume == no-op rerun
+        finally:
+            self._restore_signals()
+
+
+# ---- fleet fault isolation (pre-run) ------------------------------------
+
+
+def validate_fleet_element(cfg, trace, override: dict | None = None) -> None:
+    """Everything FleetEngine.__init__ would reject about ONE element,
+    checked in isolation: override keys/values, core count, addressing
+    line size, barrier ids vs the slot table. Raises ValueError (often
+    the located TraceError subclass)."""
+    from ..trace.format import validate_sync
+    from .fleet import apply_overrides
+
+    apply_overrides(cfg, override or {})
+    if trace.n_cores != cfg.n_cores:
+        raise ValueError(
+            f"trace has {trace.n_cores} cores, config {cfg.n_cores}"
+        )
+    if trace.line_addressed:
+        trace.line_events(cfg.line_bits)  # line-size validation only
+    validate_sync(trace, cfg.barrier_slots)
+
+
+def build_fleet_isolated(
+    cfg,
+    sources: list,
+    overrides: list[dict] | None = None,
+    chunk_steps: int = 256,
+):
+    """Build a FleetEngine from per-element sources with fault isolation.
+
+    `sources[i]` is a Trace or a zero-arg callable returning one (pass
+    callables for file loads so an unreadable/corrupt FILE quarantines
+    its element instead of killing the batch). Elements whose load or
+    validation fails are dropped; the survivors' batch positions map
+    back to caller indices through `fleet.element_ids`.
+
+    Returns `(fleet, quarantined)` where `quarantined` is a list of
+    `(original_index, exception)` and `fleet` is None when nothing
+    survived."""
+    from .fleet import FleetEngine
+
+    sources = list(sources)
+    if overrides is None:
+        overrides = [{}] * len(sources)
+    overrides = list(overrides)
+    if len(overrides) != len(sources):
+        raise ValueError(
+            f"got {len(sources)} trace sources but {len(overrides)} "
+            "override dicts (must match 1:1)"
+        )
+    kept, kept_ovs, ids = [], [], []
+    quarantined: list[tuple[int, Exception]] = []
+    for i, (src, ov) in enumerate(zip(sources, overrides)):
+        try:
+            trace = src() if callable(src) else src
+            validate_fleet_element(cfg, trace, ov)
+        except (ValueError, OSError) as e:
+            quarantined.append((i, e))
+            continue
+        kept.append(trace)
+        kept_ovs.append(ov)
+        ids.append(i)
+    if not kept:
+        return None, quarantined
+    fleet = FleetEngine(cfg, kept, kept_ovs, chunk_steps=chunk_steps)
+    fleet.element_ids = ids
+    return fleet, quarantined

@@ -249,3 +249,51 @@ def check_invariants(cfg: MachineConfig, state, done_mask=None) -> None:
             (np.asarray(state.cycles)[live] >= 0).all(),
             "invariant: negative (under-rebased) live core clock",
         )
+
+
+def check_chunk_invariants(
+    cfg: MachineConfig,
+    state,
+    done_mask=None,
+    live_mask=None,
+    prev_totals: dict | None = None,
+    totals: dict | None = None,
+) -> None:
+    """Post-chunk guard (`RunSupervisor`, `--guard=warn|fail`): the full
+    MESI/directory consistency suite plus two cross-chunk checks that
+    only make sense at a committed cut.
+
+    - clock-window: the slowest LIVE core (not at END, not frozen at a
+      barrier — `live_mask`, see Engine.live_mask) stays within one
+      quantum of `quantum_end`. The golden model asserts this every
+      step; here it is the cheap host-side witness that the engine's
+      quantum arbitration hasn't drifted.
+    - monotone counters: 64-bit host accumulator totals never decrease
+      between chunks (`prev_totals`/`totals`, name -> int) — a decrease
+      means a drain carry was lost or applied twice.
+
+    Raises AssertionError naming the violated invariant, like
+    check_invariants; the supervisor maps that to warn/fail. `state=None`
+    skips the state checks (used for the fleet's aggregate counter-total
+    check, where per-element states were already checked individually).
+    """
+    if state is not None:
+        check_invariants(cfg, state, done_mask=done_mask)
+    if state is not None and live_mask is not None:
+        live = np.asarray(live_mask)
+        if live.any():
+            qe = int(np.asarray(state.quantum_end))
+            lo = int(np.asarray(state.cycles)[live].min())
+            if qe - lo > cfg.quantum:
+                raise AssertionError(
+                    f"invariant: cycle skew {qe - lo} exceeds quantum "
+                    f"{cfg.quantum} (quantum_end={qe}, slowest live core "
+                    f"at {lo})"
+                )
+    if prev_totals is not None and totals is not None:
+        for k, v in totals.items():
+            pv = prev_totals.get(k, 0)
+            if v < pv:
+                raise AssertionError(
+                    f"invariant: counter {k!r} decreased ({pv} -> {v})"
+                )

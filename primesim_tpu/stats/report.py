@@ -26,12 +26,17 @@ def render_report(
     wall_s: float | None = None,
     per_core_limit: int = 64,
     title: str = "primesim_tpu simulation report",
+    resilience: list[str] | None = None,
 ) -> str:
     """Render the reference-style text report.
 
     `counters` is the canonical per-core counter dict (stats.counters),
     `cycles` the per-core final clocks; `wall_s` (host wall time) enables
     the MIPS line. Per-core rows are capped at `per_core_limit` (0 = all).
+    `resilience` (RunSupervisor.log_lines()) appends a RESILIENCE section
+    recording every checkpoint/retry/degradation decision of a supervised
+    run — the audit trail the failure-model contract (DESIGN.md §10)
+    promises.
     """
     C = cfg.n_cores
     ins = counters["instructions"].astype(np.int64)
@@ -98,6 +103,11 @@ def render_report(
             f"  {_rate(counters['l1_write_hits'][c], l1_writes[c])}"
             f"  {_rate(counters['llc_hits'][c], llc_acc[c])}"
         )
+    if resilience:
+        add("")
+        add("RESILIENCE")
+        for line in resilience:
+            add(f"  {line}")
     add("=" * 72)
     return "\n".join(lines) + "\n"
 

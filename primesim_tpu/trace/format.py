@@ -67,6 +67,48 @@ N_FIELDS = 4  # (type, arg, addr, pre)
 SYNC_TYPES = (EV_LOCK, EV_UNLOCK, EV_BARRIER)
 
 
+class TraceError(ValueError):
+    """Typed trace load/validation error carrying WHERE the trace is bad:
+    the source `path` (file loads), the `core` index, and the event
+    `offset` within that core's row. Fleet fault isolation
+    (sim/supervisor.py) surfaces these fields in the quarantined
+    element's JSON line so a malformed element in a thousand-element
+    sweep is diagnosable without rerunning it solo. Subclasses ValueError
+    so existing `except ValueError` callers are unaffected."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        path: str | None = None,
+        core: int | None = None,
+        offset: int | None = None,
+    ):
+        self.reason = message
+        self.path = path
+        self.core = core
+        self.offset = offset
+        where = []
+        if path is not None:
+            where.append(str(path))
+        if core is not None:
+            where.append(f"core {core}")
+        if offset is not None:
+            where.append(f"event {offset}")
+        super().__init__(": ".join(where + [message]) if where else message)
+
+    def location(self) -> dict:
+        """JSON-ready location fields (None entries omitted)."""
+        loc = {"path": self.path, "core": self.core, "offset": self.offset}
+        return {k: v for k, v in loc.items() if v is not None}
+
+
+def _first_bad(mask: np.ndarray) -> tuple[int, int]:
+    """(core, event offset) of the first True in a [n_cores, max_len] mask."""
+    c, o = np.argwhere(mask)[0]
+    return int(c), int(o)
+
+
 class Trace:
     """Per-core event arrays: events[n_cores, max_len, 4] int32 records
     (type, arg, addr, pre). With `line_addressed`, LD/ST/LOCK/UNLOCK addr
@@ -94,27 +136,65 @@ class Trace:
         self.line_bits = line_bits if line_addressed else None
         t = events[:, :, 0] if validate else np.zeros(0)
         if t.size:
-            if not ((t >= EV_INS) & (t <= EV_BARRIER)).all():
-                raise ValueError("trace contains invalid event types")
+            bad = ~((t >= EV_INS) & (t <= EV_BARRIER))
+            if bad.any():
+                c, o = _first_bad(bad)
+                raise TraceError(
+                    "trace contains invalid event types", core=c, offset=o
+                )
             mem = (t == EV_LD) | (t == EV_ST) | (t == EV_LOCK) | (t == EV_UNLOCK)
-            if (events[:, :, 2][mem] < 0).any():
-                raise ValueError("addresses must be in [0, 2^31) (31-bit)")
-            if (events[:, :, 1][t == EV_INS] < 0).any():
-                raise ValueError("INS batch counts must be >= 0")
+            bad = mem & (events[:, :, 2] < 0)
+            if bad.any():
+                c, o = _first_bad(bad)
+                raise TraceError(
+                    "addresses must be in [0, 2^31) (31-bit)", core=c, offset=o
+                )
+            bad = (t == EV_INS) & (events[:, :, 1] < 0)
+            if bad.any():
+                c, o = _first_bad(bad)
+                raise TraceError(
+                    "INS batch counts must be >= 0", core=c, offset=o
+                )
             bar = t == EV_BARRIER
-            if (events[:, :, 2][bar] < 0).any():
-                raise ValueError("barrier ids must be >= 0")
-            if (events[:, :, 1][bar] < 1).any():
-                raise ValueError("barrier participant counts must be >= 1")
-            if (events[:, :, 3][mem | bar] < 0).any():
-                raise ValueError("pre-batched instruction counts must be >= 0")
-            if (lengths > events.shape[1]).any() or (lengths < 1).any():
-                raise ValueError("per-core lengths out of range")
+            bad = bar & (events[:, :, 2] < 0)
+            if bad.any():
+                c, o = _first_bad(bad)
+                raise TraceError("barrier ids must be >= 0", core=c, offset=o)
+            bad = bar & (events[:, :, 1] < 1)
+            if bad.any():
+                c, o = _first_bad(bad)
+                raise TraceError(
+                    "barrier participant counts must be >= 1", core=c, offset=o
+                )
+            bad = (mem | bar) & (events[:, :, 3] < 0)
+            if bad.any():
+                c, o = _first_bad(bad)
+                raise TraceError(
+                    "pre-batched instruction counts must be >= 0",
+                    core=c, offset=o,
+                )
+            badlen = (lengths > events.shape[1]) | (lengths < 1)
+            if badlen.any():
+                raise TraceError(
+                    "per-core lengths out of range",
+                    core=int(np.argwhere(badlen)[0][0]),
+                )
             # every core's row must terminate: the event at lengths-1 is END
             # and padding beyond it is END (engines clamp ptr to max_len-1)
             last = events[np.arange(events.shape[0]), lengths - 1, 0]
-            if (last != EV_END).any() or (events[:, -1, 0] != EV_END).any():
-                raise ValueError("every core's event row must terminate with END")
+            bad_last = last != EV_END
+            bad_pad = events[:, -1, 0] != EV_END
+            if bad_last.any() or bad_pad.any():
+                if bad_last.any():
+                    c = int(np.argwhere(bad_last)[0][0])
+                    o = int(lengths[c]) - 1
+                else:
+                    c = int(np.argwhere(bad_pad)[0][0])
+                    o = events.shape[1] - 1
+                raise TraceError(
+                    "every core's event row must terminate with END",
+                    core=c, offset=o,
+                )
         self.events = events
         self.lengths = lengths
 
@@ -177,15 +257,17 @@ class Trace:
         with open(path, "rb") as f:
             hdr = np.fromfile(f, dtype="<u4", count=4)
             if hdr.shape[0] != 4 or hdr[0] != MAGIC:
-                raise ValueError(f"{path}: not a primesim_tpu trace file")
+                raise TraceError("not a primesim_tpu trace file", path=path)
             if hdr[1] not in (1, 2, 3, 4):
-                raise ValueError(f"{path}: unsupported trace version {hdr[1]}")
+                raise TraceError(
+                    f"unsupported trace version {hdr[1]}", path=path
+                )
             nf = 3 if hdr[1] == 1 else N_FIELDS
             flags = 0
             if hdr[1] >= 4:
                 fw = np.fromfile(f, dtype="<u4", count=1)
                 if fw.shape[0] != 1:
-                    raise ValueError(f"{path}: truncated trace file")
+                    raise TraceError("truncated trace file", path=path)
                 flags = int(fw[0])
             n_cores, max_len = int(hdr[2]), int(hdr[3])
             lengths = np.fromfile(f, dtype="<u4", count=n_cores).astype(np.int32)
@@ -193,9 +275,10 @@ class Trace:
             line_addressed = bool(flags & FLAG_LINE_ADDRESSED)
             if mmap:
                 if nf != N_FIELDS:
-                    raise ValueError(
-                        f"{path}: mmap loading requires a 4-field (v2+) "
-                        "trace; this is v1"
+                    raise TraceError(
+                        "mmap loading requires a 4-field (v2+) trace; "
+                        "this is v1",
+                        path=path,
                     )
                 events = np.memmap(
                     path, dtype="<i4", mode="r", offset=f.tell(),
@@ -210,18 +293,24 @@ class Trace:
                 )
             events = np.fromfile(f, dtype="<i4", count=n_cores * max_len * nf)
             if events.size != n_cores * max_len * nf:
-                raise ValueError(f"{path}: truncated trace file")
+                raise TraceError("truncated trace file", path=path)
             events = events.reshape(n_cores, max_len, nf).astype(np.int32)
             if nf == 3:  # v1: no pre field
                 events = np.concatenate(
                     [events, np.zeros((n_cores, max_len, 1), np.int32)], axis=2
                 )
-        return Trace(
-            events,
-            lengths,
-            line_addressed=line_addressed,
-            line_bits=lb if lb else None,
-        )
+        try:
+            return Trace(
+                events,
+                lengths,
+                line_addressed=line_addressed,
+                line_bits=lb if lb else None,
+            )
+        except TraceError as e:
+            # re-raise with the file path attached to the core/offset info
+            raise TraceError(
+                e.reason, path=path, core=e.core, offset=e.offset
+            ) from None
 
 
 def validate_sync(trace: Trace, barrier_slots: int) -> None:
@@ -232,8 +321,10 @@ def validate_sync(trace: Trace, barrier_slots: int) -> None:
     """
     _, _, bad_bid = scan_trace_meta(trace, barrier_slots)
     if bad_bid:
-        raise ValueError(
-            f"trace uses barrier ids >= barrier_slots={barrier_slots}"
+        raise TraceError(
+            f"trace uses barrier ids >= barrier_slots={barrier_slots}",
+            core=bad_bid[0],
+            offset=bad_bid[1],
         )
 
 
@@ -241,17 +332,18 @@ def scan_trace_meta(
     trace: Trace,
     barrier_slots: int,
     max_chunk_records: int = 1 << 24,
-) -> tuple[bool, int, bool]:
+) -> tuple[bool, int, tuple[int, int] | None]:
     """One bounded-memory pass over a (possibly memory-mapped) trace:
-    returns (has_sync, max per-event instruction batch, any barrier id >=
-    barrier_slots). Tiled along BOTH axes with the tile sizes co-tuned so
+    returns (has_sync, max per-event instruction batch, location of the
+    first barrier id >= barrier_slots as (core, offset) — or None when
+    all ids fit). Tiled along BOTH axes with the tile sizes co-tuned so
     one chunk holds at most `max_chunk_records` records (~256 MB at the
     default), never O(file) — row-only chunking still materialized
     rows * max_len records, which for a few-cores/very-long trace (the
     streaming engine's target shape) could itself exceed RAM."""
     has_sync = False
     per_ev = 1
-    bad_bid = False
+    bad_bid: tuple[int, int] | None = None
     events_per_chunk = min(trace.max_len, max_chunk_records)
     rows_per_chunk = max(1, max_chunk_records // events_per_chunk)
     for lo in range(0, trace.n_cores, rows_per_chunk):
@@ -271,10 +363,11 @@ def scan_trace_meta(
                 int(ev[:, :, 1].max(initial=0)),
                 int(ev[:, :, 3].max(initial=0)) + 1,
             )
-            if not bad_bid:
-                bad_bid = bool(
-                    (ev[:, :, 2][t == EV_BARRIER] >= barrier_slots).any()
-                )
+            if bad_bid is None:
+                over = (t == EV_BARRIER) & (ev[:, :, 2] >= barrier_slots)
+                if over.any():
+                    c, o = np.argwhere(over)[0]
+                    bad_bid = (int(c) + lo, int(o) + elo)
     return has_sync, per_ev, bad_bid
 
 
@@ -300,8 +393,13 @@ def from_event_lists(
             e = np.empty((len(evs), N_FIELDS), dtype=np.int32)
             e[:, 0] = arr[:, 0].astype(np.int32)
             e[:, 1] = arr[:, 1].astype(np.int32)
-            if (arr[:, 2] < 0).any() or (arr[:, 2] >= 2**31).any():
-                raise ValueError("addresses must be in [0, 2^31) (31-bit)")
+            oob = (arr[:, 2] < 0) | (arr[:, 2] >= 2**31)
+            if oob.any():
+                raise TraceError(
+                    "addresses must be in [0, 2^31) (31-bit)",
+                    core=c,
+                    offset=int(np.argwhere(oob)[0][0]),
+                )
             e[:, 2] = arr[:, 2].astype(np.int32)
             e[:, 3] = arr[:, 3].astype(np.int32)
             events[c, : len(evs)] = e

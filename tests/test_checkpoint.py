@@ -203,6 +203,38 @@ def test_fleet_checkpoint_rejects_mismatches(tmp_path):
         ).load_checkpoint(ckpt)
 
 
+def test_crash_mid_write_never_replaces_good_snapshot(tmp_path, monkeypatch):
+    # DESIGN.md §10 durability contract: saves go tmp + fsync +
+    # os.replace, so a crash mid-write leaves the previous snapshot
+    # byte-identical (and no .tmp litter)
+    from primesim_tpu.sim import checkpoint as ckpt_mod
+
+    cfg = small_test_config(8, n_banks=4, quantum=200)
+    tr = synth.fft_like(8, n_phases=2, points_per_core=12, seed=41)
+    eng = Engine(cfg, tr, chunk_steps=16)
+    eng.run_steps(16)
+    path = tmp_path / "c.npz"
+    eng.save_checkpoint(str(path))
+    good = path.read_bytes()
+
+    eng.run_steps(16)
+
+    def dies_mid_write(f, **arrays):
+        f.write(b"torn partial npz bytes")
+        raise OSError("simulated crash mid-write")
+
+    monkeypatch.setattr(ckpt_mod.np, "savez_compressed", dies_mid_write)
+    with pytest.raises(OSError, match="simulated crash"):
+        eng.save_checkpoint(str(path))
+    monkeypatch.undo()
+
+    assert path.read_bytes() == good  # untouched by the torn write
+    assert not (tmp_path / "c.npz.tmp").exists()  # tmp cleaned up
+    fresh = Engine(cfg, tr, chunk_steps=16)
+    fresh.load_checkpoint(str(path))  # and it still loads + verifies
+    assert fresh.steps_run == 16
+
+
 def test_accumulator_guard_rejects_oversized_chunks():
     from primesim_tpu.trace.format import EV_INS, from_event_lists
 
