@@ -94,6 +94,7 @@ def main() -> None:
     C = 1024
     CHUNK = int(os.environ.get("PRIMETPU_BENCH_CHUNK", "512"))
     RL = int(os.environ.get("PRIMETPU_BENCH_RL", "8"))
+    STEP_IMPL = os.environ.get("PRIMETPU_BENCH_STEP_IMPL", "xla")
     cfg = MachineConfig(
         n_cores=C,
         n_banks=C,
@@ -103,6 +104,7 @@ def main() -> None:
         dram_lat=100,
         quantum=1000,
         local_run_len=RL,
+        step_impl=STEP_IMPL,
     )
     trace = fold_ins(
         synth.fft_like(C, n_phases=4, points_per_core=256, ins_per_mem=8, seed=42)
@@ -157,6 +159,33 @@ def main() -> None:
         wall_b = _measure_fleet(cfg1, trs, CHUNK)
         fleet_scaling[str(bsz)] = round(total_ins / wall_b / 1e6, 3)
 
+    # LIVE per-phase cuts (scripts/prof/prof_phase.py source surgery) on
+    # the headline machine: cumulative ms/step at each phase marker, so
+    # every bench artifact carries the serial-chain decomposition next to
+    # the static r5 record. PRIMETPU_BENCH_PHASE_CUTS=0 skips (each cut
+    # recompiles the truncated step — ~10 extra compiles).
+    phase_ms = None
+    if os.environ.get("PRIMETPU_BENCH_PHASE_CUTS", "1") != "0":
+        import importlib.util
+
+        pp_path = os.path.join(
+            os.path.dirname(__file__), "scripts", "prof", "prof_phase.py"
+        )
+        spec = importlib.util.spec_from_file_location("prof_phase", pp_path)
+        pp = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pp)
+        cut_trace = fold_ins(
+            synth.fft_like(
+                C, n_phases=2, points_per_core=16, ins_per_mem=8, seed=42
+            )
+        )
+        phase_ms = {
+            k: round(v, 3)
+            for k, v in pp.phase_cuts(
+                cfg, cut_trace, n_steps=64, repeats=2
+            ).items()
+        }
+
     print(
         json.dumps(
             {
@@ -175,14 +204,19 @@ def main() -> None:
                     "noc_msgs": int(eng.counters["noc_msgs"].sum()),
                     "local_run_len": RL,
                     "chunk_steps": CHUNK,
+                    "step_impl": STEP_IMPL,
+                    # live cumulative phase cuts on THIS machine/backend
+                    # (None when PRIMETPU_BENCH_PHASE_CUTS=0)
+                    "phase_ms_cuts_measured": phase_ms,
                     "rung3_shipped_config": detail_r3,
                     # aggregate MIPS batching B sims through one program
                     # (rung-1/64-core config, one distinct trace per
                     # element)
                     "fleet_scaling": fleet_scaling,
                     # STATIC RECORD: round-5 restructure evidence measured
-                    # on TPU 2026-07-30 (prof_phase.py cumulative cuts /
-                    # prof_bisect.py ablations, flagship shapes, rl=8).
+                    # on TPU 2026-07-30 (scripts/prof/prof_phase.py
+                    # cumulative cuts / prof_bisect.py ablations,
+                    # flagship shapes, rl=8).
                     # Per-KERNEL overhead dominates this workload; the
                     # remaining floor is the step's serial kernel chain.
                     "perf_evidence_static_r5": {

@@ -100,6 +100,7 @@ def _emit_summary(
     tot_ins = int(counters["instructions"].sum())
     detail = {
         "engine": engine_name,
+        "step_impl": cfg.step_impl if engine_name != "golden" else None,
         "n_cores": cfg.n_cores,
         "instructions": tot_ins,
         "max_core_cycles": int(max(cycles)),
@@ -204,8 +205,16 @@ def _run_supervised(ns, cfg, eng) -> int:
     return 0
 
 
+def _apply_step_impl(ns, cfg):
+    if getattr(ns, "step_impl", None) and ns.step_impl != cfg.step_impl:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, step_impl=ns.step_impl)
+    return cfg
+
+
 def cmd_run(ns) -> int:
-    cfg = _load_config(ns.config)
+    cfg = _apply_step_impl(ns, _load_config(ns.config))
     tr = _load_trace(ns, cfg.n_cores, line_bits=cfg.line_bits)
     if tr.n_cores != cfg.n_cores:
         raise SystemExit(
@@ -421,7 +430,7 @@ def cmd_sweep(ns) -> int:
     any bad element fatal instead."""
     import os
 
-    cfg = _load_config(ns.config)
+    cfg = _apply_step_impl(ns, _load_config(ns.config))
     _check_supervision_flags(ns)
     from ..trace.format import Trace, TraceError, fold_ins
 
@@ -687,6 +696,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--fold", action="store_true", help="fold INS batches into pre fields"
     )
     r.add_argument("--engine", choices=("jax", "golden"), default="jax")
+    r.add_argument(
+        "--step-impl", choices=("xla", "pallas"), default=None,
+        help="step implementation (jax engine): 'pallas' routes phase "
+             "1/4 + the reductions through the fused VMEM step kernels "
+             "(kernels/, DESIGN.md §11); default: the config's step_impl",
+    )
     r.add_argument("--chunk-steps", type=int, default=256)
     r.add_argument(
         "--max-steps", type=int, default=None,
@@ -745,6 +760,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     w.add_argument(
         "--fold", action="store_true", help="fold INS batches into pre fields"
+    )
+    w.add_argument(
+        "--step-impl", choices=("xla", "pallas"), default=None,
+        help="step implementation for every fleet element (geometry-keyed "
+             "like the rest of the jit key: the whole sweep still "
+             "compiles once; timing knobs stay traced)",
     )
     w.add_argument("--chunk-steps", type=int, default=256)
     w.add_argument("--max-steps", type=int, default=None)

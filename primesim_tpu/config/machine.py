@@ -205,6 +205,16 @@ class MachineConfig:
     # commute). Both engines implement the identical model; parity is
     # proven at small scale with G in {4, 32} (tests/test_coarse.py).
     sharer_group: int = 1
+    # Step-body implementation (DESIGN.md §11): "xla" keeps the original
+    # per-phase gather/scatter graph; "pallas" routes the step's dominant
+    # serial segments through the VMEM-resident fused kernels in
+    # primesim_tpu/kernels/ (probe_classify + commit, plus the sharer
+    # reduction) to beat the per-kernel-overhead floor on TPU. Bit-exact
+    # either way (tests/test_step_pallas.py proves golden/xla/pallas
+    # three-way parity); a GEOMETRY selector, so it is part of the jit
+    # key but timing knobs stay traced — fleet sweeps still compile once.
+    # On non-TPU backends the kernels run in Pallas interpreter mode.
+    step_impl: str = "xla"
 
     def __post_init__(self):
         self.validate()
@@ -250,6 +260,8 @@ class MachineConfig:
                 "pallas_reduce covers the dense full-map reduction only "
                 "(sharer_group == 1, sharer_chunk_words == 0)"
             )
+        if self.step_impl not in ("xla", "pallas"):
+            raise ValueError("step_impl must be 'xla' or 'pallas'")
         if self.sharer_chunk_words < 0:
             raise ValueError("sharer_chunk_words must be >= 0")
         if self.sharer_chunk_words and (

@@ -1,0 +1,104 @@
+"""Shared block geometry + Mosaic-safe idioms for the step kernels.
+
+VMEM BLOCK LAYOUT (DESIGN.md §11). All step kernels block the CORE axis:
+grid = (C // core_block(C),), every per-core operand arrives as a
+[BC, width] VMEM block with index map `lambda i: (i, 0)` (the counter
+array, [n_counters, C], blocks its LANE axis instead: `lambda i: (0, i)`).
+Widths are the engine's own fused-array layouts, staged verbatim:
+
+- L1 block: [BC, 5 * W1 * S1] — five planes (tag/state/lru/ptr/epoch) at
+  an FS = W1*S1 column stride, way w of set s of plane p at column
+  p*FS + w*S1 + s (sim/state.py).
+- Directory rows: [BC, DW] — tag/owner pairs at columns 2w / 2w+1, LRU at
+  2*W2 + w, epoch at 3*W2 + w, zero padding to MW = llc_meta_width, then
+  sharer word n of way w at MW + w*NW + n (sim/state.py).
+- Lane vectors ([C] classification flags and ids) ride as [BC, 1]
+  columns; traced step scalars as (1, 1) blocks broadcast to every grid
+  step.
+
+MOSAIC IDIOMS. TPU Pallas rejects minor-dim reshapes and data-dependent
+gathers, so every "index with a computed id" becomes a static unroll of
+masked selects (`select_col`, `across`) and every argmax/argmin becomes
+the first-occurrence emulation (`first_true` / `first_min`) — all
+bit-exact against the XLA step's jnp.argmax/argmin/take_along_axis
+semantics, which the parity suite proves.
+
+Kernels must NOT derive core ids from `pl.program_id`: the fleet engine
+vmaps the whole step, and the Pallas batching rule prepends a grid axis,
+which would silently renumber the blocks. Global core ids arrive as a
+[BC, 1] input instead (`sharer_reductions` set the pattern).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def core_block(C: int) -> int:
+    """Core-axis block size: full 128-lane blocks when the core count
+    allows, else one block of all C cores (small test geometries)."""
+    return 128 if C % 128 == 0 else C
+
+
+def interpret_mode() -> bool:
+    """Run kernels in Pallas interpreter mode off-TPU so the identical
+    kernel logic is exercised (and tier-1-gated) on CPU."""
+    return jax.default_backend() != "tpu"
+
+
+def block_spec(width: int):
+    """BlockSpec tuple args for a [BC, width] core-axis block."""
+    return width, (lambda i: (i, 0))
+
+
+def select_col(mat, idx, ncols: int, colf=None):
+    """mat[:, colf(v)] at v = idx per row — a data-dependent column pick
+    as a static unroll of masked adds. `mat` [BC, W], `idx` [BC, 1],
+    colf maps v -> static column (default identity). Returns [BC, 1]."""
+    colf = colf or (lambda v: v)
+    acc = jnp.zeros_like(idx)
+    for v in range(ncols):
+        c = colf(v)
+        acc = acc + jnp.where(idx == v, mat[:, c : c + 1], 0)
+    return acc
+
+
+def across(vals, width: int):
+    """Pack a list of `width` [BC, 1] columns into one [BC, width] value
+    via one-hot masked adds (no concatenate on the lane dim)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, width), 1)
+    acc = jnp.zeros((vals[0].shape[0], width), jnp.int32)
+    for k, v in enumerate(vals):
+        acc = acc + jnp.where(iota == k, v.astype(jnp.int32), 0)
+    return acc
+
+
+def first_true(mask):
+    """jnp.argmax semantics over axis 1 of a [BC, W] bool: index of the
+    FIRST True, 0 when none. Returns ([BC, 1] any, [BC, 1] index)."""
+    W = mask.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    any_ = jnp.max(mask.astype(jnp.int32), axis=1, keepdims=True) != 0
+    idx = jnp.min(jnp.where(mask, iota, W), axis=1, keepdims=True)
+    return any_, jnp.where(any_, idx, 0)
+
+
+def first_min(vals):
+    """jnp.argmin semantics over axis 1 of a [BC, W] int32: index of the
+    FIRST minimum. Returns [BC, 1]."""
+    W = vals.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    m = jnp.min(vals, axis=1, keepdims=True)
+    return jnp.min(jnp.where(vals == m, iota, W), axis=1, keepdims=True)
+
+
+def popcount(x):
+    """Per-element bit count of nonneg int32 words, shift/mask form (no
+    multiply that could wrap; matches lax.population_count exactly)."""
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    x = x + (x >> 8)
+    x = x + (x >> 16)
+    return x & 0x3F

@@ -1,133 +1,6 @@
-"""Pallas TPU kernel for the dense sharer-expansion reductions
-(SURVEY.md §2 #4/#6's "part of the Pallas uncore kernel" column).
+"""Import shim — the dense sharer-reduction kernel moved into the step
+subsystem as `primesim_tpu.kernels.reductions` (DESIGN.md §11), where it
+is the third resident kernel next to probe_classify and commit_step.
+Kept so external callers of the historical path keep working."""
 
-The step's invalidation / back-invalidation reductions expand each
-winner's packed sharer words into per-target-core booleans and reduce
-latencies/counts/hops over the target axis — a dense [C_block, C] tiled
-computation with NO data-dependent indexing, which is the shape TPU
-Pallas handles well: the word->bit expansion is a static masked select
-(Mosaic rejects the reshape `jnp.repeat` would emit), and pair
-latencies come from index arithmetic. `pallas_reduce=true` in
-MachineConfig routes the engine's full-map dense path through this
-kernel; results are BIT-IDENTICAL to the jnp path (tests/test_pallas.py
-runs the golden parity suite through it).
-
-Scope note (an honest engineering finding, not a TODO): the rest of the
-step is gather/scatter over multi-hundred-MB directory arrays with
-data-dependent indices — the access pattern TPU Pallas's block model is
-worst at — so the kernel boundary is drawn around the dense reduction,
-and the gathers stay with XLA, which lowers them natively.
-
-On non-TPU backends the kernel runs in Pallas interpreter mode, so the
-parity suite exercises the identical kernel logic on CPU.
-"""
-
-from __future__ import annotations
-
-import functools
-
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-
-from ..config.machine import MachineConfig
-
-
-def _expand_bits(words, t, NW: int):
-    """[BC, NW] packed words -> [BC, NW*32] per-target booleans, column
-    c = bit (c % 32) of word (c // 32). Static masked select per word:
-    Mosaic-friendly (no minor-dim reshape, no gather)."""
-    wsel = t >> 5
-    rep = jnp.zeros(t.shape, jnp.int32)
-    for w in range(NW):
-        rep = rep + jnp.where(wsel == w, words[:, w][:, None], 0)
-    return ((rep >> (t & 31)) & 1) != 0
-
-
-def _reduce_kernel(
-    shw_ref, vic_ref, btile_ref, vic_owner_ref, inv_row_ref, vic_valid_ref,
-    self_ref,
-    inv_lat_ref, inv_cnt_ref, inv_hops_ref, back_cnt_ref, back_hops_ref,
-    *, C: int, NW: int, n_tiles: int, mesh_x: int, link_lat: int,
-    router_lat: int,
-):
-    BC = shw_ref.shape[0]
-    t = jax.lax.broadcasted_iota(jnp.int32, (BC, NW * 32), 1)  # target ids
-    bits = _expand_bits(shw_ref[...], t, NW)  # recorded targets
-    vbits = _expand_bits(vic_ref[...], t, NW)
-    tvalid = t < C
-    # pair geometry: home tile of this row vs target tile, from indices
-    bt = btile_ref[...]  # [BC, 1]
-    tt = t % n_tiles
-    bx, by = bt % mesh_x, bt // mesh_x
-    tx, ty = tt % mesh_x, tt // mesh_x
-    hops = jnp.abs(bx - tx) + jnp.abs(by - ty)
-    lat2 = 2 * (hops * link_lat + (hops + 1) * router_lat)
-    hops2 = 2 * hops
-    selfid = self_ref[...]
-    inv_row = inv_row_ref[...] != 0
-    sh_b = bits & (t != selfid) & inv_row & tvalid
-    inv_lat_ref[...] = jnp.max(
-        jnp.where(sh_b, lat2, 0), axis=1, keepdims=True
-    )
-    inv_cnt_ref[...] = jnp.sum(
-        sh_b.astype(jnp.int32), axis=1, keepdims=True
-    )
-    inv_hops_ref[...] = jnp.sum(
-        jnp.where(sh_b, hops2, 0), axis=1, keepdims=True
-    )
-    vic_owner = vic_owner_ref[...]
-    vic_valid = vic_valid_ref[...] != 0
-    ob = (t == vic_owner) & (vic_owner >= 0)
-    bk_b = (vbits | ob) & vic_valid & tvalid
-    back_cnt_ref[...] = jnp.sum(
-        bk_b.astype(jnp.int32), axis=1, keepdims=True
-    )
-    back_hops_ref[...] = jnp.sum(
-        jnp.where(bk_b, hops2, 0), axis=1, keepdims=True
-    )
-
-
-@functools.partial(jax.jit, static_argnums=(0,))
-def sharer_reductions(
-    cfg: MachineConfig, shw, vic_shw, btile, vic_owner, inv_row, vic_valid,
-    arange_c,
-):
-    """Dense invalidation/back-invalidation reductions as one Pallas
-    kernel: returns (inv_lat, inv_count, inv_hops, back_count,
-    back_hops), each [C] int32 — bit-identical to the engine's jnp dense
-    path. Full-map vectors only (cfg validation enforces it)."""
-    C = cfg.n_cores
-    NW = cfg.n_sharer_words
-    BC = 128 if C % 128 == 0 else C
-    kern = functools.partial(
-        _reduce_kernel,
-        C=C,
-        NW=NW,
-        n_tiles=cfg.n_tiles,
-        mesh_x=cfg.noc.mesh_x,
-        link_lat=cfg.noc.link_lat,
-        router_lat=cfg.noc.router_lat,
-    )
-    col = lambda i: (i, 0)
-    out = pl.pallas_call(
-        kern,
-        grid=(C // BC,),
-        in_specs=[
-            pl.BlockSpec((BC, NW), col),
-            pl.BlockSpec((BC, NW), col),
-        ]
-        + [pl.BlockSpec((BC, 1), col)] * 5,
-        out_specs=[pl.BlockSpec((BC, 1), col)] * 5,
-        out_shape=[jax.ShapeDtypeStruct((C, 1), jnp.int32)] * 5,
-        interpret=jax.default_backend() != "tpu",
-    )(
-        shw.astype(jnp.int32),
-        vic_shw.astype(jnp.int32),
-        btile.astype(jnp.int32)[:, None],
-        vic_owner.astype(jnp.int32)[:, None],
-        inv_row.astype(jnp.int32)[:, None],
-        vic_valid.astype(jnp.int32)[:, None],
-        arange_c.astype(jnp.int32)[:, None],
-    )
-    return tuple(o[:, 0] for o in out)
+from ..kernels.reductions import sharer_reductions  # noqa: F401

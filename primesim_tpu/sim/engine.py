@@ -46,7 +46,16 @@ from ..trace.format import (
     EV_UNLOCK,
     Trace,
 )
-from .state import E, I, M, MachineState, S, init_state, llc_meta_width
+from .state import (
+    E,
+    I,
+    M,
+    MachineState,
+    S,
+    dirm_width,
+    init_state,
+    llc_meta_width,
+)
 
 INT32_MAX = np.int32(2**31 - 1)
 _ACC_BITS = 30  # device counter accumulators carry into hi above 2^30
@@ -269,12 +278,15 @@ def step(
         _cacc[name] = a if name not in _cacc else _cacc[name] + a
         return cnt
 
-    def cflush(cnt):
+    def cstack():
         rows = [
             _cacc[k] if k in _cacc else jnp.zeros(C, jnp.int32)
             for k in COUNTER_NAMES
         ]
-        return cnt + jnp.stack(rows)
+        return jnp.stack(rows)
+
+    def cflush(cnt):
+        return cnt + cstack()
 
     cnt = st.counters
 
@@ -482,15 +494,48 @@ def step(
     et, earg, eaddr, epre = ev[:, 0], ev[:, 1], ev[:, 2], ev[:, 3]
     line = eaddr
     l1s = line & (S1 - 1)
-    w1cols, tag_rows, lru_rows, weff = _l1_probe(
-        cfg, arange_c, l1_c, st.dirm, line,
-        run_patch=(hm, wm, cm) if rl else None,
-        step_no=step_no,
-    )
-    l1_match = (tag_rows == line[:, None]) & (weff != I)
-    hit_any = jnp.any(l1_match, axis=1)
-    hit_way = jnp.argmax(l1_match, axis=1).astype(jnp.int32)
-    hit_state = weff[arange_c, hit_way]
+    pallas_step = cfg.step_impl == "pallas"
+    if pallas_step:
+        # [PALLAS] fused probe_classify (DESIGN.md §11): phase 1 AND the
+        # LLC home-row parse below run as ONE VMEM-blocked kernel. XLA
+        # keeps only the two row gathers that STAGE the directory rows
+        # into the kernel (data-dependent row gathers are the one access
+        # shape the block model cannot express); everything downstream of
+        # them — plane selects, pointer validation, classification,
+        # sharer predicates, victim selection — fuses.
+        from ..kernels.step_kernels import probe_classify
+
+        DWK = dirm_width(cfg)
+        bank = line & (B - 1)
+        bset = (line >> logB) & (S2 - 1)
+        slot = bank * S2 + bset
+        meta_rows = st.dirm[slot]  # [C, DW], reused by commit_step
+        w1cols = jnp.arange(W1, dtype=jnp.int32)[None, :] * S1 + l1s[:, None]
+        ptr_pre = jnp.take_along_axis(l1_c, w1cols + 3 * FS, axis=1)
+        vrows = st.dirm[ptr_pre // W2].reshape(C, W1 * DWK)
+        tag_rows, lru_rows, weff, shw, vic_shw, pc_lanes = probe_classify(
+            cfg, l1_c, vrows, meta_rows, line, arange_c, step_no,
+            *((hm, wm, cm) if rl else ()),
+        )
+        from ..kernels.step_kernels import (
+            PL_HIT_ANY,
+            PL_HIT_STATE,
+            PL_HIT_WAY,
+        )
+
+        hit_any = pc_lanes[:, PL_HIT_ANY] != 0
+        hit_way = pc_lanes[:, PL_HIT_WAY]
+        hit_state = pc_lanes[:, PL_HIT_STATE]
+    else:
+        w1cols, tag_rows, lru_rows, weff = _l1_probe(
+            cfg, arange_c, l1_c, st.dirm, line,
+            run_patch=(hm, wm, cm) if rl else None,
+            step_no=step_no,
+        )
+        l1_match = (tag_rows == line[:, None]) & (weff != I)
+        hit_any = jnp.any(l1_match, axis=1)
+        hit_way = jnp.argmax(l1_match, axis=1).astype(jnp.int32)
+        hit_state = weff[arange_c, hit_way]
 
     not_done = et != EV_END
     frozen = (et == EV_BARRIER) & (st.sync_flag != 0)
@@ -514,20 +559,30 @@ def step(
     # ONE full-row gather returns the home set's tags, owners AND LRU
     # stamps; the owner, victim-owner and victim-LRU reads below become
     # in-register row indexing instead of separate element gathers.
-    bank = line & (B - 1)
-    bset = (line >> logB) & (S2 - 1)
-    slot = bank * S2 + bset  # [C], exact (bank,set) id
-    meta_rows = st.dirm[slot]  # [C, DW]: the set's metadata AND sharers
-    mr2 = meta_rows[:, : 2 * W2].reshape(C, W2, 2)
-    llc_tag_rows = mr2[..., 0]  # [C, W2]
-    owner_rows = mr2[..., 1]
-    llc_match = llc_tag_rows == line[:, None]
-    llc_has = jnp.any(llc_match, axis=1)
-    llc_hway = jnp.argmax(llc_match, axis=1).astype(jnp.int32)
-    owner = owner_rows[arange_c, llc_hway]  # [C]
-    # the sharer words came along in the same row gather
-    sh_rows = meta_rows[:, MW:].reshape(C, W2, NW)  # [C, W2, NW]
-    shw = jnp.take_along_axis(sh_rows, llc_hway[:, None, None], axis=1)[:, 0]
+    if pallas_step:
+        # [PALLAS] parse already fused into probe_classify; unpack lanes
+        from ..kernels.step_kernels import PL_LLC_HAS, PL_LLC_HWAY, PL_OWNER
+
+        llc_has = pc_lanes[:, PL_LLC_HAS] != 0
+        llc_hway = pc_lanes[:, PL_LLC_HWAY]
+        owner = pc_lanes[:, PL_OWNER]
+    else:
+        bank = line & (B - 1)
+        bset = (line >> logB) & (S2 - 1)
+        slot = bank * S2 + bset  # [C], exact (bank,set) id
+        meta_rows = st.dirm[slot]  # [C, DW]: the set's metadata AND sharers
+        mr2 = meta_rows[:, : 2 * W2].reshape(C, W2, 2)
+        llc_tag_rows = mr2[..., 0]  # [C, W2]
+        owner_rows = mr2[..., 1]
+        llc_match = llc_tag_rows == line[:, None]
+        llc_has = jnp.any(llc_match, axis=1)
+        llc_hway = jnp.argmax(llc_match, axis=1).astype(jnp.int32)
+        owner = owner_rows[arange_c, llc_hway]  # [C]
+        # the sharer words came along in the same row gather
+        sh_rows = meta_rows[:, MW:].reshape(C, W2, NW)  # [C, W2, NW]
+        shw = jnp.take_along_axis(
+            sh_rows, llc_hway[:, None, None], axis=1
+        )[:, 0]
 
     # sharer-set predicates from the PACKED words — popcount minus the
     # self bit needs no [C, C] expansion (the expansion, when needed for
@@ -546,17 +601,25 @@ def step(
         # expansion at G=1)
         return jnp.take(groups, g_c, axis=1)
 
-    self_bit = ((shw[arange_c, word_idx] >> bit_idx) & 1).astype(jnp.int32)
-    total_sharers = jnp.sum(
-        jax.lax.population_count(shw), axis=1
-    ).astype(jnp.int32)
-    if cfg.sharer_group > 1:
-        # coarse: the requester's own group bit may cover OTHER cores, so
-        # exclusivity (E grants) requires an empty vector (golden
-        # `shared_any`)
-        other_sharers = total_sharers > 0
+    if pallas_step:
+        from ..kernels.step_kernels import PL_OTHER_SH, PL_SELF_BIT
+
+        self_bit = pc_lanes[:, PL_SELF_BIT]
+        other_sharers = pc_lanes[:, PL_OTHER_SH] != 0
     else:
-        other_sharers = (total_sharers - self_bit) > 0
+        self_bit = (
+            (shw[arange_c, word_idx] >> bit_idx) & 1
+        ).astype(jnp.int32)
+        total_sharers = jnp.sum(
+            jax.lax.population_count(shw), axis=1
+        ).astype(jnp.int32)
+        if cfg.sharer_group > 1:
+            # coarse: the requester's own group bit may cover OTHER
+            # cores, so exclusivity (E grants) requires an empty vector
+            # (golden `shared_any`)
+            other_sharers = total_sharers > 0
+        else:
+            other_sharers = (total_sharers - self_bit) > 0
 
     # ---- phase 2: read-join coalescing + per-(bank,set) arbitration ------
     # GETS to an LLC-resident, ownerless, already-shared line may coalesce:
@@ -681,13 +744,29 @@ def step(
     write_probe = write_w & llc_hit & has_owner
 
     # --- LLC miss: victim + back-invalidation
-    llc_state_valid = llc_tag_rows != -1
-    llc_lru_rows = meta_rows[:, 2 * W2 : 3 * W2]  # [C, W2], from the row gather
-    vkey = jnp.where(llc_state_valid, llc_lru_rows, -1)
-    llc_vway = jnp.argmin(vkey, axis=1).astype(jnp.int32)
-    vic_tag = llc_tag_rows[arange_c, llc_vway]
-    vic_owner = owner_rows[arange_c, llc_vway]
-    vic_shw = jnp.take_along_axis(sh_rows, llc_vway[:, None, None], axis=1)[:, 0]
+    if pallas_step:
+        # [PALLAS] victim chosen inside probe_classify (first-minimum
+        # LRU over valid ways, identical tie-breaking); vic_shw is a
+        # kernel output
+        from ..kernels.step_kernels import (
+            PL_LLC_VWAY,
+            PL_VIC_OWNER,
+            PL_VIC_TAG,
+        )
+
+        vic_tag = pc_lanes[:, PL_VIC_TAG]
+        vic_owner = pc_lanes[:, PL_VIC_OWNER]
+        llc_vway = pc_lanes[:, PL_LLC_VWAY]
+    else:
+        llc_state_valid = llc_tag_rows != -1
+        llc_lru_rows = meta_rows[:, 2 * W2 : 3 * W2]  # [C, W2], row gather
+        vkey = jnp.where(llc_state_valid, llc_lru_rows, -1)
+        llc_vway = jnp.argmin(vkey, axis=1).astype(jnp.int32)
+        vic_tag = llc_tag_rows[arange_c, llc_vway]
+        vic_owner = owner_rows[arange_c, llc_vway]
+        vic_shw = jnp.take_along_axis(
+            sh_rows, llc_vway[:, None, None], axis=1
+        )[:, 0]
     vic_valid = llc_miss & (vic_tag != -1)
 
     # --- invalidation + back-invalidation target reductions. Targets come
@@ -816,15 +895,18 @@ def step(
         (inv_lat, inv_count, inv_hops, back_count, back_hops), _ = jax.lax.scan(
             _blk, (z5, z5, z5, z5, z5), jnp.arange(nblk, dtype=jnp.int32)
         )
-    elif cfg.pallas_reduce:
+    elif cfg.pallas_reduce or pallas_step:
         # same dense reduction as the branch below, as ONE Pallas kernel
-        # (SURVEY §2 #4's Pallas uncore piece); bit-identical
-        from ..ops.reductions import sharer_reductions
+        # (SURVEY §2 #4's Pallas uncore piece; the step subsystem's third
+        # resident kernel — step_impl="pallas" routes it unconditionally);
+        # bit-identical. Latencies are the TRACED knobs, so fleet sweeps
+        # through this kernel compile once per geometry.
+        from ..kernels.reductions import sharer_reductions
 
         (inv_lat, inv_count, inv_hops, back_count, back_hops) = (
             sharer_reductions(
                 cfg, shw, vic_shw, btile, vic_owner, inv_row, vic_valid,
-                arange_c,
+                arange_c, kn.link_lat, kn.router_lat,
             )
         )
     else:
@@ -1097,215 +1179,264 @@ def step(
         jnp.where(is_ins, earg, 0) + jnp.where(mem_ret, epre + 1, 0),
     )
 
-    # L1-side updates touch at most TWO (row, column) slots per core — the
-    # retired way, and (for fills) a stale duplicate of the filled tag —
-    # so each is a [C]-element scatter into the [C, W1*S1] arrays, not a
-    # full-array one-hot select (which rewrites 4x8MB per step at 1024
-    # cores). Rows are the core's own, columns flat way*S1 + set; masked
-    # lanes scatter to dropped row C.
-
-    # winner L1 update: UPG-in-place vs fill. Victim preference counts
-    # directory-invalidated (stale) ways as free, matching eager-MESI's
-    # invalid-first rule; the victim writeback fires only on EFFECTIVE M.
-    upg_in_place = upg & winner  # upg requires an L1 hit: always in-place
-    fill = (winner & ~upg_in_place) | join
-    l1_vkey = jnp.where(weff == I, -1, lru_rows)  # lru_rows from the probe
-    l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
-    cnt = cadd(cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M))
-    upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
-    hit_col = hit_way * S1 + l1s
-    upd_col = upd_way * S1 + l1s
-
-    # a fill may duplicate a stale way's tag: clear the stale copy so tags
-    # stay unique per set (else the refill could "resurrect" it, since the
-    # directory once again records this core for the line); uniqueness also
-    # means at most one duplicate way exists
-    tagm = tag_rows == line[:, None]  # [C, W1], any state
-    t_way = jnp.argmax(tagm, axis=1).astype(jnp.int32)
-    dup = fill & jnp.any(tagm, axis=1) & (t_way != upd_way)
-    dup_row = jnp.where(dup, arange_c, C)
-    dup_col = t_way * S1 + l1s
-
-    wj = winner | join
-    lru_row = jnp.where(hit | wj, arange_c, C)
-    lru_col = jnp.where(hit, hit_col, upd_col)
-    st_row = jnp.where(write_hit | wj, arange_c, C)  # silent E->M + grants
-    st_col = jnp.where(write_hit, hit_col, upd_col)
-    st_val = jnp.where(write_hit, M, grant)
-    wj_row = jnp.where(wj, arange_c, C)
-    # the filled line's directory entry position (way pointer); joins and
-    # LLC hits fill at the line's hit way, misses at the victim
-    fill_ptr = slot * W2 + jnp.where(join | llc_hit, llc_hway, llc_vway)
-    # invalidation epoch: every sharer-CLEARING transition (M grants,
-    # exclusive grants, fills — exactly the owner-taking ones) bumps the
-    # entry's epoch so coarse-vector validation can reject pre-clearing
-    # fill records (GETS probe/shared grants preserve sharers: no bump);
-    # fills record the POST-bump value
-    llc_uway = jnp.where(llc_hit, llc_hway, llc_vway)
-    takes_own = write_w | gets_excl_hit | llc_miss
-    eph_rows2 = meta_rows[:, 3 * W2 : 4 * W2]  # [C, W2]
-    eph_way = jnp.where(join, llc_hway, llc_uway)
-    new_eph = eph_rows2[arange_c, eph_way] + takes_own.astype(jnp.int32)
-    # ALL of this step's L1 writes — the seven phase-4 columns AND the
-    # local run's deferred LRU/E->M writes — in ONE scatter on the fused
-    # plane array (per-kernel overhead dominates, and a second scatter
-    # chained on the same array cannot alias its operand). Targets are
-    # pairwise distinct up to benign identical-value duplicates:
-    # dup_col != upd_col (a duplicate is a different way than the fill
-    # target), hit refresh and grant rows are disjoint lane classes, each
-    # write addresses its own plane, run-LRU duplicates of phase-4 LRU
-    # writes carry the identical step stamp, and a run E->M colliding
-    # with a phase-4 state write at the same way is SUPPRESSED (phase 4
-    # wrote after the run in the serialized order, so its value wins).
-    l1_rows = [dup_row, dup_row, lru_row, st_row, wj_row, wj_row, wj_row]
-    l1_cols = [
-        dup_col,  # stale duplicate tag clear
-        dup_col + FS,  # stale duplicate state clear
-        lru_col + 2 * FS,  # hit refresh / fill LRU stamp
-        st_col + FS,  # silent E->M + grant state
-        upd_col,  # fill tag
-        upd_col + 3 * FS,  # fill way pointer
-        upd_col + 4 * FS,  # fill-time entry epoch (post-bump)
-    ]
-    l1_vals = [
-        jnp.full(C, -1, jnp.int32),
-        jnp.full(C, I, jnp.int32),
-        jnp.broadcast_to(step_no, (C,)),
-        st_val,
-        line,
-        fill_ptr,
-        new_eph,
-    ]
-    rows_mat = jnp.stack(l1_rows, axis=1)
-    cols_mat = jnp.stack(l1_cols, axis=1)
-    vals_mat = jnp.stack(l1_vals, axis=1)
-    if rl:
-        own_state_write = (st_row == arange_c)
-        run_m_sup = wm & ~(own_state_write[:, None] & (st_col[:, None] == cm))
-        rows_mat = jnp.concatenate(
+    if pallas_step:
+        # [PALLAS] fused commit (DESIGN.md §11): victim choice and the
+        # writeback counter stay in-register here (they feed cadd), and
+        # the join-LRU representative scatter-min keeps its tiny XLA
+        # table, but EVERY array write of phase 4.A — the 7 + 2*rl L1
+        # plane writes, the directory row delta, and the stacked counter
+        # fold — is deferred into ONE commit_step kernel call at the end
+        # of the step (after phase 2.7 contributes its counter deltas).
+        upg_in_place = upg & winner  # upg requires an L1 hit: in-place
+        fill = (winner & ~upg_in_place) | join
+        l1_vkey = jnp.where(weff == I, -1, lru_rows)
+        l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
+        cnt = cadd(
+            cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M)
+        )
+        takes_own = write_w | gets_excl_hit | llc_miss
+        st_val_m = jnp.where(write_hit, M, grant)
+        jsw = jnp.where(join, slot * W2 + llc_hway, B * S2 * W2)
+        jtab = jnp.full(B * S2 * W2, INT32_MAX, jnp.int32).at[jsw].min(
+            key, mode="drop"
+        )
+        jrep = join & (
+            jtab[jnp.minimum(slot * W2 + llc_hway, B * S2 * W2 - 1)] == key
+        )
+        upd_slot = jnp.where(winner | join, slot, B * S2)
+        commit_lanes = jnp.stack(
             [
-                rows_mat,
-                jnp.where(hm, arange_c[:, None], C),
-                jnp.where(run_m_sup, arange_c[:, None], C),
+                line,
+                hit_way,
+                l1_vway,
+                hit.astype(jnp.int32),
+                write_hit.astype(jnp.int32),
+                upg_in_place.astype(jnp.int32),
+                winner.astype(jnp.int32),
+                join.astype(jnp.int32),
+                llc_hit.astype(jnp.int32),
+                st_val_m,
+                slot,
+                llc_hway,
+                llc_vway,
+                jrep.astype(jnp.int32),
+                takes_own.astype(jnp.int32),
+                gets_probe.astype(jnp.int32),
+                gets_shared.astype(jnp.int32),
+                oclamp,
             ],
             axis=1,
-        )
-        cols_mat = jnp.concatenate(
-            [cols_mat, cm + 2 * FS, cm + FS], axis=1
-        )
-        vals_mat = jnp.concatenate(
-            [
-                vals_mat,
-                jnp.broadcast_to(step_no, (C, rl)),
-                jnp.full((C, rl), M, jnp.int32),
-            ],
-            axis=1,
-        )
-    l1_n = l1_c.at[rows_mat, cols_mat].set(vals_mat, mode="drop")
+        )  # column order = kernels.step_kernels CL_* indices
+    else:
+        # L1-side updates touch at most TWO (row, column) slots per core — the
+        # retired way, and (for fills) a stale duplicate of the filled tag —
+        # so each is a [C]-element scatter into the [C, W1*S1] arrays, not a
+        # full-array one-hot select (which rewrites 4x8MB per step at 1024
+        # cores). Rows are the core's own, columns flat way*S1 + set; masked
+        # lanes scatter to dropped row C.
 
-    # Directory update: ONE full-row scatter-ADD covers the winner's
-    # whole row — tags, owner, LRU, epoch, AND sharer words — plus every
-    # join's sharer bit (winner and join slots are disjoint: join slots
-    # never have a winner). Winner rows carry the exact full-row delta
-    # (new - old; exactly one winner per slot, so old + delta == new,
-    # wrap-safe in int32); join rows contribute only the joiner's own
-    # bit, masked against the step-start word (self_word & ~shw) so a
-    # silently-evicted re-joiner's stale bit cannot carry into the
-    # adjacent bit — golden's _set_sharer is idempotent, the masked add
-    # matches it; multiple joiners per slot add distinct bits. Join LRU
-    # refreshes land in a second element scatter (same-slot joiners write
-    # the identical step stamp).
-    new_owner = jnp.where(takes_own, arange_c, -1)
-    wayeq = jnp.arange(W2, dtype=jnp.int32)[None, :] == llc_uway[:, None]
-    new_meta = jnp.concatenate(
-        [
-            jnp.stack(
+        # winner L1 update: UPG-in-place vs fill. Victim preference counts
+        # directory-invalidated (stale) ways as free, matching eager-MESI's
+        # invalid-first rule; the victim writeback fires only on EFFECTIVE M.
+        upg_in_place = upg & winner  # upg requires an L1 hit: always in-place
+        fill = (winner & ~upg_in_place) | join
+        l1_vkey = jnp.where(weff == I, -1, lru_rows)  # lru_rows from the probe
+        l1_vway = jnp.argmin(l1_vkey, axis=1).astype(jnp.int32)
+        cnt = cadd(cnt, "l1_writebacks", fill & (weff[arange_c, l1_vway] == M))
+        upd_way = jnp.where(upg_in_place, hit_way, l1_vway)
+        hit_col = hit_way * S1 + l1s
+        upd_col = upd_way * S1 + l1s
+
+        # a fill may duplicate a stale way's tag: clear the stale copy so tags
+        # stay unique per set (else the refill could "resurrect" it, since the
+        # directory once again records this core for the line); uniqueness also
+        # means at most one duplicate way exists
+        tagm = tag_rows == line[:, None]  # [C, W1], any state
+        t_way = jnp.argmax(tagm, axis=1).astype(jnp.int32)
+        dup = fill & jnp.any(tagm, axis=1) & (t_way != upd_way)
+        dup_row = jnp.where(dup, arange_c, C)
+        dup_col = t_way * S1 + l1s
+
+        wj = winner | join
+        lru_row = jnp.where(hit | wj, arange_c, C)
+        lru_col = jnp.where(hit, hit_col, upd_col)
+        st_row = jnp.where(write_hit | wj, arange_c, C)  # silent E->M + grants
+        st_col = jnp.where(write_hit, hit_col, upd_col)
+        st_val = jnp.where(write_hit, M, grant)
+        wj_row = jnp.where(wj, arange_c, C)
+        # the filled line's directory entry position (way pointer); joins and
+        # LLC hits fill at the line's hit way, misses at the victim
+        fill_ptr = slot * W2 + jnp.where(join | llc_hit, llc_hway, llc_vway)
+        # invalidation epoch: every sharer-CLEARING transition (M grants,
+        # exclusive grants, fills — exactly the owner-taking ones) bumps the
+        # entry's epoch so coarse-vector validation can reject pre-clearing
+        # fill records (GETS probe/shared grants preserve sharers: no bump);
+        # fills record the POST-bump value
+        llc_uway = jnp.where(llc_hit, llc_hway, llc_vway)
+        takes_own = write_w | gets_excl_hit | llc_miss
+        eph_rows2 = meta_rows[:, 3 * W2 : 4 * W2]  # [C, W2]
+        eph_way = jnp.where(join, llc_hway, llc_uway)
+        new_eph = eph_rows2[arange_c, eph_way] + takes_own.astype(jnp.int32)
+        # ALL of this step's L1 writes — the seven phase-4 columns AND the
+        # local run's deferred LRU/E->M writes — in ONE scatter on the fused
+        # plane array (per-kernel overhead dominates, and a second scatter
+        # chained on the same array cannot alias its operand). Targets are
+        # pairwise distinct up to benign identical-value duplicates:
+        # dup_col != upd_col (a duplicate is a different way than the fill
+        # target), hit refresh and grant rows are disjoint lane classes, each
+        # write addresses its own plane, run-LRU duplicates of phase-4 LRU
+        # writes carry the identical step stamp, and a run E->M colliding
+        # with a phase-4 state write at the same way is SUPPRESSED (phase 4
+        # wrote after the run in the serialized order, so its value wins).
+        l1_rows = [dup_row, dup_row, lru_row, st_row, wj_row, wj_row, wj_row]
+        l1_cols = [
+            dup_col,  # stale duplicate tag clear
+            dup_col + FS,  # stale duplicate state clear
+            lru_col + 2 * FS,  # hit refresh / fill LRU stamp
+            st_col + FS,  # silent E->M + grant state
+            upd_col,  # fill tag
+            upd_col + 3 * FS,  # fill way pointer
+            upd_col + 4 * FS,  # fill-time entry epoch (post-bump)
+        ]
+        l1_vals = [
+            jnp.full(C, -1, jnp.int32),
+            jnp.full(C, I, jnp.int32),
+            jnp.broadcast_to(step_no, (C,)),
+            st_val,
+            line,
+            fill_ptr,
+            new_eph,
+        ]
+        rows_mat = jnp.stack(l1_rows, axis=1)
+        cols_mat = jnp.stack(l1_cols, axis=1)
+        vals_mat = jnp.stack(l1_vals, axis=1)
+        if rl:
+            own_state_write = (st_row == arange_c)
+            run_m_sup = wm & ~(own_state_write[:, None] & (st_col[:, None] == cm))
+            rows_mat = jnp.concatenate(
                 [
-                    jnp.where(wayeq, line[:, None], llc_tag_rows),
-                    jnp.where(wayeq, new_owner[:, None], owner_rows),
+                    rows_mat,
+                    jnp.where(hm, arange_c[:, None], C),
+                    jnp.where(run_m_sup, arange_c[:, None], C),
                 ],
-                axis=-1,
-            ).reshape(C, 2 * W2),
-            jnp.where(wayeq, step_no, llc_lru_rows),
-            jnp.where(wayeq, new_eph[:, None], eph_rows2),
-            jnp.zeros((C, MW - 4 * W2), jnp.int32),
-        ],
-        axis=1,
-    )
+                axis=1,
+            )
+            cols_mat = jnp.concatenate(
+                [cols_mat, cm + 2 * FS, cm + FS], axis=1
+            )
+            vals_mat = jnp.concatenate(
+                [
+                    vals_mat,
+                    jnp.broadcast_to(step_no, (C, rl)),
+                    jnp.full((C, rl), M, jnp.int32),
+                ],
+                axis=1,
+            )
+        l1_n = l1_c.at[rows_mat, cols_mat].set(vals_mat, mode="drop")
 
-    # new sharer words [C, NW]
-    self_word = (
-        (jnp.arange(NW)[None, :] == word_idx[:, None]).astype(jnp.int32)
-        << bit_idx[:, None]
-    )  # bit(c) as packed words
-    # the probed owner is re-recorded as a sharer unconditionally: the home
-    # node cannot observe silent L1 evictions (golden does the same), and
-    # this keeps the transition free of cross-core L1 reads — which under
-    # core-axis sharding would all-gather the L1 arrays every step
-    og_bit = oclamp >> logG  # owner's sharer-GROUP bit (identity at G=1)
-    owner_word = jnp.where(
-        jnp.arange(NW)[None, :] == (og_bit // 32)[:, None],
-        jnp.int32(1) << (og_bit % 32)[:, None],
-        0,
-    )
-    new_shw = jnp.where(
-        gets_probe[:, None],
-        self_word | owner_word,
-        jnp.where(
-            gets_shared[:, None],
-            shw | self_word,
-            jnp.zeros_like(shw),  # M grants, E grants, misses: cleared
-        ),
-    )
-    way_seg = (
-        jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_uway[:, None]
-    )
-    old_flat = sh_rows.reshape(C, W2 * NW)
-    new_sh_row = jnp.where(
-        way_seg,
-        jnp.broadcast_to(new_shw[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
-        old_flat,
-    )
-    join_seg = (
-        jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_hway[:, None]
-    )
-    join_word = self_word & ~shw  # carry-free when the bit is already set
-    join_sh_row = jnp.where(
-        join_seg,
-        jnp.broadcast_to(join_word[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
-        0,
-    )
-    # Join LRU refreshes ride the SAME scatter-add: adds only commute for
-    # identical targets if exactly one lane carries the delta, so a
-    # per-(slot, way) scatter-min on the (small, 16 MB) representative
-    # table picks one joiner per joined way to add (step_no - old_lru);
-    # same-way co-joiners add zero. A second element scatter chained
-    # after the row-add was measured at ~5 ms/step (prof_bisect r5: any
-    # read-modify-write scatter that cannot alias re-materializes the
-    # 800 MB operand), so everything must go through the ONE add.
-    jsw = jnp.where(join, slot * W2 + llc_hway, B * S2 * W2)
-    jtab = jnp.full(B * S2 * W2, INT32_MAX, jnp.int32).at[jsw].min(
-        key, mode="drop"
-    )
-    jrep = join & (
-        jtab[jnp.minimum(slot * W2 + llc_hway, B * S2 * W2 - 1)] == key
-    )
-    old_lru_h = meta_rows[arange_c, 2 * W2 + llc_hway]
-    lru_oh = (
-        jnp.arange(MW, dtype=jnp.int32)[None, :]
-        == (2 * W2 + llc_hway)[:, None]
-    )
-    join_meta = jnp.where(
-        lru_oh, jnp.where(jrep, step_no - old_lru_h, 0)[:, None], 0
-    )
-    new_full = jnp.concatenate([new_meta, new_sh_row], axis=1)  # [C, DW]
-    delta_row = jnp.where(
-        winner[:, None],
-        new_full - meta_rows,
-        jnp.concatenate([join_meta, join_sh_row], axis=1),
-    )
-    upd_slot = jnp.where(winner | join, slot, B * S2)
-    dirm_n = st.dirm.at[upd_slot].add(delta_row, mode="drop")
+        # Directory update: ONE full-row scatter-ADD covers the winner's
+        # whole row — tags, owner, LRU, epoch, AND sharer words — plus every
+        # join's sharer bit (winner and join slots are disjoint: join slots
+        # never have a winner). Winner rows carry the exact full-row delta
+        # (new - old; exactly one winner per slot, so old + delta == new,
+        # wrap-safe in int32); join rows contribute only the joiner's own
+        # bit, masked against the step-start word (self_word & ~shw) so a
+        # silently-evicted re-joiner's stale bit cannot carry into the
+        # adjacent bit — golden's _set_sharer is idempotent, the masked add
+        # matches it; multiple joiners per slot add distinct bits. Join LRU
+        # refreshes land in a second element scatter (same-slot joiners write
+        # the identical step stamp).
+        new_owner = jnp.where(takes_own, arange_c, -1)
+        wayeq = jnp.arange(W2, dtype=jnp.int32)[None, :] == llc_uway[:, None]
+        new_meta = jnp.concatenate(
+            [
+                jnp.stack(
+                    [
+                        jnp.where(wayeq, line[:, None], llc_tag_rows),
+                        jnp.where(wayeq, new_owner[:, None], owner_rows),
+                    ],
+                    axis=-1,
+                ).reshape(C, 2 * W2),
+                jnp.where(wayeq, step_no, llc_lru_rows),
+                jnp.where(wayeq, new_eph[:, None], eph_rows2),
+                jnp.zeros((C, MW - 4 * W2), jnp.int32),
+            ],
+            axis=1,
+        )
+
+        # new sharer words [C, NW]
+        self_word = (
+            (jnp.arange(NW)[None, :] == word_idx[:, None]).astype(jnp.int32)
+            << bit_idx[:, None]
+        )  # bit(c) as packed words
+        # the probed owner is re-recorded as a sharer unconditionally: the home
+        # node cannot observe silent L1 evictions (golden does the same), and
+        # this keeps the transition free of cross-core L1 reads — which under
+        # core-axis sharding would all-gather the L1 arrays every step
+        og_bit = oclamp >> logG  # owner's sharer-GROUP bit (identity at G=1)
+        owner_word = jnp.where(
+            jnp.arange(NW)[None, :] == (og_bit // 32)[:, None],
+            jnp.int32(1) << (og_bit % 32)[:, None],
+            0,
+        )
+        new_shw = jnp.where(
+            gets_probe[:, None],
+            self_word | owner_word,
+            jnp.where(
+                gets_shared[:, None],
+                shw | self_word,
+                jnp.zeros_like(shw),  # M grants, E grants, misses: cleared
+            ),
+        )
+        way_seg = (
+            jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_uway[:, None]
+        )
+        old_flat = sh_rows.reshape(C, W2 * NW)
+        new_sh_row = jnp.where(
+            way_seg,
+            jnp.broadcast_to(new_shw[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
+            old_flat,
+        )
+        join_seg = (
+            jnp.arange(W2 * NW, dtype=jnp.int32)[None, :] // NW == llc_hway[:, None]
+        )
+        join_word = self_word & ~shw  # carry-free when the bit is already set
+        join_sh_row = jnp.where(
+            join_seg,
+            jnp.broadcast_to(join_word[:, None, :], (C, W2, NW)).reshape(C, W2 * NW),
+            0,
+        )
+        # Join LRU refreshes ride the SAME scatter-add: adds only commute for
+        # identical targets if exactly one lane carries the delta, so a
+        # per-(slot, way) scatter-min on the (small, 16 MB) representative
+        # table picks one joiner per joined way to add (step_no - old_lru);
+        # same-way co-joiners add zero. A second element scatter chained
+        # after the row-add was measured at ~5 ms/step (prof_bisect r5: any
+        # read-modify-write scatter that cannot alias re-materializes the
+        # 800 MB operand), so everything must go through the ONE add.
+        jsw = jnp.where(join, slot * W2 + llc_hway, B * S2 * W2)
+        jtab = jnp.full(B * S2 * W2, INT32_MAX, jnp.int32).at[jsw].min(
+            key, mode="drop"
+        )
+        jrep = join & (
+            jtab[jnp.minimum(slot * W2 + llc_hway, B * S2 * W2 - 1)] == key
+        )
+        old_lru_h = meta_rows[arange_c, 2 * W2 + llc_hway]
+        lru_oh = (
+            jnp.arange(MW, dtype=jnp.int32)[None, :]
+            == (2 * W2 + llc_hway)[:, None]
+        )
+        join_meta = jnp.where(
+            lru_oh, jnp.where(jrep, step_no - old_lru_h, 0)[:, None], 0
+        )
+        new_full = jnp.concatenate([new_meta, new_sh_row], axis=1)  # [C, DW]
+        delta_row = jnp.where(
+            winner[:, None],
+            new_full - meta_rows,
+            jnp.concatenate([join_meta, join_sh_row], axis=1),
+        )
+        upd_slot = jnp.where(winner | join, slot, B * S2)
+        dirm_n = st.dirm.at[upd_slot].add(delta_row, mode="drop")
 
     # No phase 4.B: under pull-based coherence, the directory updates above
     # ARE the invalidations/downgrades — remote L1s re-derive their state on
@@ -1429,6 +1560,24 @@ def step(
         barrier_count = jnp.where(drained, 0, barrier_count)
         barrier_time = jnp.where(drained, 0, barrier_time)
 
+    if pallas_step:
+        # [PALLAS] end-of-step fused commit: by now phase 2.7's sync
+        # counters have joined the delta accumulator, so ONE kernel call
+        # performs every deferred array write of the step — the
+        # 7 + 2*rl-column L1 plane scatter, the per-core directory row
+        # delta, and the full counter fold. The single data-dependent
+        # row scatter the block model cannot express stays in XLA.
+        from ..kernels.step_kernels import commit_step
+
+        l1_n, delta_row, counters_final = commit_step(
+            cfg, l1_c, meta_rows, tag_rows, shw, commit_lanes, arange_c,
+            step_no, cnt, cstack(),
+            *((hm, wm, cm) if rl else ()),
+        )
+        dirm_n = st.dirm.at[upd_slot].add(delta_row, mode="drop")
+    else:
+        counters_final = cflush(cnt)
+
     return MachineState(
         cycles=cycles,
         ptr=ptr,
@@ -1442,7 +1591,7 @@ def step(
         sync_flag=sync_flag,
         quantum_end=quantum_end,
         step=step_no + 1,
-        counters=cflush(cnt),
+        counters=counters_final,
         knobs=kn,
     )
 

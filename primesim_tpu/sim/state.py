@@ -32,7 +32,11 @@ def llc_meta_width(cfg: MachineConfig) -> int:
 
 def dirm_width(cfg: MachineConfig) -> int:
     """Full `dirm` row width: metadata prefix + W2*NW packed sharer
-    words."""
+    words. These row/plane layouts are a PUBLIC contract: the Pallas
+    step kernels (kernels/layouts.py, DESIGN.md §11) stage `dirm` rows
+    and the five-plane L1 blocks into VMEM verbatim and hard-code the
+    same column maps — change a layout here and the kernels' index maps
+    must move with it (the three-way parity suite catches drift)."""
     return llc_meta_width(cfg) + cfg.llc.ways * cfg.n_sharer_words
 
 

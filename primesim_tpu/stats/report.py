@@ -83,6 +83,8 @@ def render_report(
     add(f"  NoC messages        {int(counters['noc_msgs'].sum()):>16,}")
     add(f"  NoC hops            {int(counters['noc_hops'].sum()):>16,}")
     add(f"  arbitration retries {int(counters['retries'].sum()):>16,}")
+    add(f"  NoC contention cyc  {int(counters['noc_contention_cycles'].sum()):>16,}")
+    add(f"  DRAM queue cycles   {int(counters['dram_queue_cycles'].sum()):>16,}")
     locks = int(counters["lock_acquires"].sum())
     if locks or int(counters["barrier_waits"].sum()):
         add(f"  lock acquires       {locks:>16,}")

@@ -39,8 +39,11 @@ CUTS = [
      "cycles_c + ptr_c + weff.sum(1) + winner + lat + lat_join + et"),
     ("p4_counters", "# ---- phase 4.A",
      "cycles_c + ptr_c + weff.sum(1) + winner + lat + noc_msgs + et"),
+    # keep expr must resolve under BOTH step impls: the xla branch binds
+    # l1_n at this cut, the pallas branch binds commit_lanes instead
     ("p4a_l1", "# Directory update:",
-     "cycles + ptr + l1_n.sum(1) + lat"),
+     "cycles + ptr + lat + (l1_n.sum(1) if cfg.step_impl == 'xla'"
+     " else commit_lanes.sum(1))"),
     ("full", None, None),
 ]
 
@@ -71,33 +74,45 @@ def build(name, marker, keep):
     return ns["run_chunk"]
 
 
+def phase_cuts(cfg, trace, n_steps: int = 256, repeats: int = 3):
+    """Measure every cumulative phase cut on (cfg, trace): returns an
+    ordered {cut_name: ms_per_step} dict (each entry includes everything
+    before it; successive deltas localize a phase's cost). This is the
+    callable form bench.py folds into its BENCH detail — same source
+    surgery, caller's config (works under either step_impl)."""
+    events = jnp.asarray(trace.line_events(cfg.line_bits))
+    out_ms = {}
+    for name, marker, keep in CUTS:
+        rc = build(name, marker, keep)
+        st = init_state(cfg)
+        out = rc(cfg, n_steps, events, st)
+        np.asarray(out.step)  # compile + first run outside the clock
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = rc(cfg, n_steps, events, out)
+        np.asarray(out.step)
+        dt = (time.perf_counter() - t0) / repeats / n_steps
+        out_ms[name] = dt * 1e3
+    return out_ms
+
+
 def main():
     C = 1024
     rl = int(os.environ.get("PRIMETPU_PROF_RL", "8"))
+    impl = os.environ.get("PRIMETPU_PROF_STEP_IMPL", "xla")
     cfg = MachineConfig(n_cores=C, n_banks=C,
         l1=CacheConfig(size=32 * 1024, ways=4, line=64, latency=2),
         llc=CacheConfig(size=256 * 1024, ways=8, line=64, latency=10),
         noc=NocConfig(mesh_x=32, mesh_y=32, link_lat=1, router_lat=1),
-        dram_lat=100, quantum=1000, local_run_len=rl)
-    print(f"local_run_len={rl}")
+        dram_lat=100, quantum=1000, local_run_len=rl, step_impl=impl)
+    print(f"local_run_len={rl} step_impl={impl}")
     trace = fold_ins(synth.fft_like(C, n_phases=2, points_per_core=16,
                                     ins_per_mem=8, seed=42))
-    events = jnp.asarray(trace.line_events(cfg.line_bits))
-    n = 256
     prev = 0.0
-    for name, marker, keep in CUTS:
-        rc = build(name, marker, keep)
-        st = init_state(cfg)
-        out = rc(cfg, n, events, st)
-        np.asarray(out.step)
-        t0 = time.perf_counter()
-        for _ in range(3):
-            out = rc(cfg, n, events, out)
-        np.asarray(out.step)
-        dt = (time.perf_counter() - t0) / 3 / n
-        print(f"[{name:14s}] {dt*1e3:7.3f} ms/step  (+{(dt-prev)*1e3:6.3f})",
+    for name, ms in phase_cuts(cfg, trace).items():
+        print(f"[{name:14s}] {ms:7.3f} ms/step  (+{ms - prev:6.3f})",
               flush=True)
-        prev = dt
+        prev = ms
 
 
 if __name__ == "__main__":

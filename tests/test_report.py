@@ -38,6 +38,8 @@ def test_render_report_golden():
         dram_accesses=[5, 0],
         noc_msgs=[20, 8],
         noc_hops=[40, 16],
+        noc_contention_cycles=[12, 3],
+        dram_queue_cycles=[7, 0],
     )
     cycles = np.array([2000, 1000], dtype=np.int64)
     text = render_report(cfg, counters, cycles, wall_s=0.5)
@@ -58,6 +60,8 @@ def test_render_report_golden():
     assert "  LLC hit rate                  50.00%" in text  # 5/10
     assert "  DRAM accesses                      5" in text
     assert "  NoC messages                      28" in text
+    assert "  NoC contention cyc                15" in text
+    assert "  DRAM queue cycles                  7" in text
     # no sync activity -> the lock/barrier block is omitted entirely
     assert "lock acquires" not in text
     assert "PER-CORE (first 2 of 2)" in text

@@ -1,0 +1,207 @@
+"""Pallas step-kernel subsystem (kernels/ — ISSUE 4 tentpole): routing
+the step's phase-1 probe/classification and phase-4 commit through the
+fused VMEM kernels (`step_impl="pallas"`) must be BIT-EXACT — cycles,
+every stat counter, and the full machine state — against both the golden
+model and the XLA step, on every workload generator and machine mode.
+Interpreter mode on CPU runs the identical kernel logic tier-1-gated;
+compiled on TPU.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import (
+    CacheConfig,
+    MachineConfig,
+    NocConfig,
+    small_test_config,
+)
+from primesim_tpu.sim.engine import Engine
+from primesim_tpu.trace import synth
+
+from test_parity import assert_parity
+
+
+def _pallas(cfg):
+    return dataclasses.replace(cfg, step_impl="pallas")
+
+
+def assert_xla_pallas_match(cfg_xla, trace, chunk_steps=16):
+    """Direct xla-vs-pallas compare of EVERYTHING an engine run produces:
+    final cycles plus every MachineState field (L1 planes, directory
+    rows, NoC/DRAM queue state, sync tables, counters, step)."""
+    ex = Engine(cfg_xla, trace, chunk_steps=chunk_steps)
+    ex.run()
+    ep = Engine(_pallas(cfg_xla), trace, chunk_steps=chunk_steps)
+    ep.run()
+    np.testing.assert_array_equal(ex.cycles, ep.cycles, err_msg="cycles")
+    for f in ex.state._fields:
+        if f == "knobs":
+            continue  # inputs, identical by construction
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ex.state, f)),
+            np.asarray(getattr(ep.state, f)),
+            err_msg=f"state field {f}",
+        )
+
+
+GENERATOR_TRACES = {
+    "uniform_random": lambda: synth.uniform_random(8, n_mem_ops=50, seed=42),
+    "stream": lambda: synth.stream(8, n_mem_ops=50, seed=43),
+    "pointer_chase": lambda: synth.pointer_chase(
+        8, n_mem_ops=40, n_nodes=32, seed=44
+    ),
+    "false_sharing": lambda: synth.false_sharing(8, n_mem_ops=40, seed=45),
+    "fft_like": lambda: synth.fft_like(
+        8, n_phases=2, points_per_core=8, seed=46
+    ),
+    "readers_writer": lambda: synth.readers_writer(8, n_rounds=3, seed=47),
+    "lock_contention": lambda: synth.lock_contention(8, n_critical=6, seed=48),
+    "barrier_phases": lambda: synth.barrier_phases(8, n_phases=3, seed=49),
+}
+
+
+@pytest.mark.parametrize("gen", sorted(GENERATOR_TRACES))
+def test_three_way_parity_every_generator(gen):
+    # golden vs pallas engine (assert_parity) AND xla vs pallas full
+    # state: together the three implementations agree bit-for-bit
+    cfg = small_test_config(8, n_banks=4, quantum=300)
+    tr = GENERATOR_TRACES[gen]()
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr, chunk_steps=32)
+
+
+def test_parity_local_runs():
+    # rl > 0: the kernels take the deferred run-patch masks (hm/wm/cm)
+    # as extra inputs — probe applies them, commit writes them back
+    cfg = small_test_config(8, n_banks=4, quantum=400, local_run_len=4)
+    tr = synth.false_sharing(8, n_mem_ops=40, seed=9)
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr)
+
+
+def test_parity_folded_trace():
+    from primesim_tpu.trace.format import fold_ins
+
+    cfg = small_test_config(8, n_banks=4, quantum=400, local_run_len=4)
+    tr = fold_ins(synth.fft_like(8, n_phases=2, points_per_core=8, seed=50))
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr)
+
+
+def test_parity_coarse_directory():
+    # sharer_group > 1: group-granular sharer words + the epoch planes'
+    # validation guard, both inside the kernels
+    cfg = small_test_config(8, n_banks=4, quantum=400, sharer_group=4)
+    tr = synth.readers_writer(8, n_rounds=3, seed=10)
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr)
+
+
+def test_parity_router_noc_and_dram_queue():
+    # cross-step queue state (link_free / dram_free) composes with the
+    # kernels: phases 1/4 are fused, phase 3's queueing stays XLA
+    noc = NocConfig(
+        mesh_x=2, mesh_y=2, link_lat=1, router_lat=1,
+        contention=True, contention_model="router", contention_lat=2,
+    )
+    cfg = small_test_config(8, n_banks=4, quantum=400, noc=noc)
+    assert_xla_pallas_match(cfg, synth.uniform_random(8, n_mem_ops=40, seed=11))
+    cfg2 = small_test_config(
+        8, n_banks=4, quantum=400, dram_queue=True, dram_service=8
+    )
+    assert_xla_pallas_match(
+        cfg2, synth.uniform_random(8, n_mem_ops=40, seed=12)
+    )
+
+
+def test_parity_with_pallas_reduce_combined():
+    # step_impl="pallas" already routes reductions through the kernel;
+    # setting pallas_reduce=True too must be equivalent, not conflicting
+    cfg = small_test_config(8, n_banks=4, quantum=400, pallas_reduce=True)
+    tr = synth.false_sharing(8, n_mem_ops=40, seed=13)
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+
+
+def test_parity_64core_multiblock():
+    # C=64 still runs as one [64, ...] block (core_block pads at 128
+    # multiples only), but exercises multi-word sharer sets, a tiny LLC
+    # with back-invalidations, and a 4x4 mesh
+    cfg = MachineConfig(
+        n_cores=64, n_banks=16,
+        l1=CacheConfig(size=1024, ways=2, line=64, latency=2),
+        llc=CacheConfig(size=4096, ways=4, line=64, latency=10),
+        noc=NocConfig(mesh_x=4, mesh_y=4),
+        quantum=500,
+    )
+    tr = synth.readers_writer(64, n_rounds=2, block_lines=4, seed=14)
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr, chunk_steps=32)
+
+
+def test_fleet_vmapped_pallas_step():
+    # the fleet vmaps the whole step: the kernels must batch correctly
+    # (no pl.program_id — core ids are data), with per-element traced
+    # knob overrides still compiling ONCE
+    from primesim_tpu.sim.fleet import FleetEngine, apply_overrides
+
+    cfg = small_test_config(8, n_banks=4, quantum=300, step_impl="pallas")
+    traces = [
+        synth.false_sharing(8, n_mem_ops=40, seed=21),
+        synth.uniform_random(8, n_mem_ops=60, seed=22),
+        synth.lock_contention(8, n_critical=6, seed=23),
+    ]
+    overrides = [
+        {},
+        {"llc_lat": 25, "dram_lat": 140, "l1_lat": 4},
+        {"quantum": 150, "cpi": 2},
+    ]
+    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
+    fleet.run()
+    assert fleet.done()
+    for i, (t, ov) in enumerate(zip(traces, overrides)):
+        solo = Engine(apply_overrides(cfg, ov), t, chunk_steps=32)
+        solo.run()
+        np.testing.assert_array_equal(
+            fleet.cycles[i], solo.cycles, err_msg=f"elem {i} cycles"
+        )
+        es = fleet.element_state(i)
+        for f in es._fields:
+            if f == "knobs":
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(getattr(es, f)),
+                np.asarray(getattr(solo.state, f)),
+                err_msg=f"elem {i} state field {f}",
+            )
+
+
+def test_fleet_vmapped_pallas_coarse():
+    # coarse directory under the vmapped kernels (sharer_group is part
+    # of the geometry key, shared by every element)
+    from primesim_tpu.sim.fleet import FleetEngine
+
+    cfg = small_test_config(
+        8, n_banks=4, quantum=300, sharer_group=4, step_impl="pallas"
+    )
+    traces = [
+        synth.readers_writer(8, n_rounds=3, seed=24),
+        synth.false_sharing(8, n_mem_ops=40, seed=25),
+    ]
+    fleet = FleetEngine(cfg, traces, chunk_steps=32)
+    fleet.run()
+    assert fleet.done()
+    for i, t in enumerate(traces):
+        solo = Engine(cfg, t, chunk_steps=32)
+        solo.run()
+        np.testing.assert_array_equal(
+            fleet.cycles[i], solo.cycles, err_msg=f"elem {i} cycles"
+        )
+
+
+def test_step_impl_validation_and_default():
+    assert small_test_config(4).step_impl == "xla"  # default untouched
+    with pytest.raises(ValueError, match="step_impl"):
+        small_test_config(4, step_impl="mosaic")
