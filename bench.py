@@ -111,6 +111,10 @@ def main() -> None:
     )
     n_instructions = trace.total_instructions()
 
+    # the faults-off zero-overhead contract (DESIGN.md §12): the headline
+    # number must measure the pre-fault step graph — a config that arms
+    # fault injection would silently bench the chaos path instead
+    assert not cfg.faults_enabled, "headline bench config must keep faults off"
     eng, wall, walls = _measure(cfg, trace, CHUNK)
     mips = n_instructions / wall / 1e6
     agg_cycles = int(np.asarray(eng.cycles).max())
@@ -205,6 +209,10 @@ def main() -> None:
                     "local_run_len": RL,
                     "chunk_steps": CHUNK,
                     "step_impl": STEP_IMPL,
+                    # asserted off above: the headline measures the
+                    # pre-fault step graph (DESIGN.md §12 zero-overhead
+                    # contract)
+                    "faults_enabled": cfg.faults_enabled,
                     # live cumulative phase cuts on THIS machine/backend
                     # (None when PRIMETPU_BENCH_PHASE_CUTS=0)
                     "phase_ms_cuts_measured": phase_ms,

@@ -213,8 +213,43 @@ def _apply_step_impl(ns, cfg):
     return cfg
 
 
+def _apply_faults(ns, cfg):
+    """Apply --fault-schedule/--fault-seed (DESIGN.md §12) to the config.
+
+    The schedule sets the STATIC fault geometry (faults_enabled,
+    max_fault_events, policies) — part of the jit key; the seed is a
+    TRACED value, so `sweep --vary fault_seed=...` reuses one compiled
+    program across the whole chaos sweep."""
+    schedule = getattr(ns, "fault_schedule", None)
+    seed = getattr(ns, "fault_seed", None)
+    if schedule:
+        from ..faults.schedule import load_schedule
+
+        cfg = load_schedule(schedule).apply(cfg, seed=seed or 0)
+    elif seed is not None:
+        if not cfg.faults_enabled:
+            raise SystemExit(
+                "--fault-seed without --fault-schedule needs a config with "
+                "faults_enabled (the seed only feeds an armed fault model)"
+            )
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, fault_seed=seed)
+    return cfg
+
+
 def cmd_run(ns) -> int:
-    cfg = _apply_step_impl(ns, _load_config(ns.config))
+    cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
+    if cfg.faults_enabled and ns.engine == "golden":
+        raise SystemExit(
+            "fault injection requires --engine jax (the golden oracle "
+            "models the fault-free machine)"
+        )
+    if cfg.faults_enabled and ns.stream_window:
+        raise SystemExit(
+            "fault injection does not compose with --stream-window yet "
+            "(window rebasing assumes the fault-free retirement order)"
+        )
     tr = _load_trace(ns, cfg.n_cores, line_bits=cfg.line_bits)
     if tr.n_cores != cfg.n_cores:
         raise SystemExit(
@@ -430,7 +465,7 @@ def cmd_sweep(ns) -> int:
     any bad element fatal instead."""
     import os
 
-    cfg = _apply_step_impl(ns, _load_config(ns.config))
+    cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
     _check_supervision_flags(ns)
     from ..trace.format import Trace, TraceError, fold_ins
 
@@ -677,6 +712,20 @@ def _add_resilience_flags(sp) -> None:
     )
 
 
+def _add_fault_flags(sp) -> None:
+    """Shared run/sweep fault-injection surface (DESIGN.md §12)."""
+    sp.add_argument(
+        "--fault-schedule", metavar="FILE",
+        help="JSON fault schedule (events + flip/DUE rates + policies); "
+             "arms the deterministic fault model (DESIGN.md §12)",
+    )
+    sp.add_argument(
+        "--fault-seed", type=int, default=None, metavar="N",
+        help="seed for the counter-based fault PRNG (traced: sweeping it "
+             "never recompiles; default 0)",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="primetpu",
@@ -736,6 +785,7 @@ def build_parser() -> argparse.ArgumentParser:
              "(cores/L1s by core, LLC/directory by bank; jax engine)",
     )
     _add_resilience_flags(r)
+    _add_fault_flags(r)
     r.set_defaults(fn=cmd_run)
 
     w = sub.add_parser(
@@ -756,7 +806,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--vary", action="append", metavar="K=V[,K=V...]",
         help="one fleet element's timing overrides (repeatable; keys: "
              "quantum, cpi, l1_lat, llc_lat, link_lat, router_lat, "
-             "dram_lat, dram_service, contention_lat)",
+             "dram_lat, dram_service, contention_lat, fault_seed)",
     )
     w.add_argument(
         "--fold", action="store_true", help="fold INS batches into pre fields"
@@ -780,6 +830,7 @@ def build_parser() -> argparse.ArgumentParser:
              "instead of being quarantined into its own JSON line",
     )
     _add_resilience_flags(w)
+    _add_fault_flags(w)
     w.set_defaults(fn=cmd_sweep)
 
     c = sub.add_parser(
@@ -815,7 +866,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     ns = build_parser().parse_args(argv)
+    from ..config.machine import FaultConfigError
+
     try:
         return ns.fn(ns)
+    except FaultConfigError as e:
+        # typed schedule/config errors carry (site, step, field) — show
+        # the operator exactly which entry is wrong
+        print(f"fault config error: {e} [{e.location()}]", file=sys.stderr)
+        return 2
     except BrokenPipeError:  # e.g. `primetpu info cfg | head`
         return 0

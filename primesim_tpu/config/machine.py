@@ -22,6 +22,55 @@ def _is_pow2(x: int) -> bool:
     return x > 0 and (x & (x - 1)) == 0
 
 
+class FaultConfigError(ValueError):
+    """A fault schedule or fault knob is malformed (DESIGN.md §12).
+
+    Mirrors trace.format.TraceError: keyword fields locate the offending
+    entry so the CLI prints `fault schedule: core:9 at step 100: ...`
+    instead of a bare traceback, and `.location()` feeds structured
+    (JSON-line) error reporting.
+
+    `site` names the injection target ("core:3", "link:17"), `step` the
+    scheduled step, `field` the offending config/schedule field.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        site: str | None = None,
+        step: int | None = None,
+        field: str | None = None,
+    ):
+        self.site = site
+        self.step = step
+        self.field = field
+        where = []
+        if site is not None:
+            where.append(str(site))
+        if step is not None:
+            where.append(f"step {step}")
+        if field is not None:
+            where.append(f"field {field!r}")
+        prefix = f"fault schedule: {', '.join(where)}: " if where else "fault schedule: "
+        super().__init__(prefix + message)
+
+    def location(self) -> dict:
+        """Non-None locator fields, for structured error lines."""
+        out = {}
+        for k in ("site", "step", "field"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+
+#: Fault event kinds (config/schedule encoding; see faults/schedule.py)
+FAULT_CORE_FAILSTOP = 1  # a = core id: fail-stop at the scheduled step
+FAULT_LINK_FAIL = 2  # a = directed link id: permanent link failure
+FAULT_LINK_DEGRADE = 3  # a = link id, b = extra cycles per traversal
+
+
 @dataclass(frozen=True)
 class CacheConfig:
     """Geometry + latency of one cache level (private L1 or one LLC bank)."""
@@ -215,6 +264,32 @@ class MachineConfig:
     # key but timing knobs stay traced — fleet sweeps still compile once.
     # On non-TPU backends the kernels run in Pallas interpreter mode.
     step_impl: str = "xla"
+    # ---- fault injection (DESIGN.md §12) --------------------------------
+    # `faults_enabled` is a STATIC model selector: when False (default)
+    # the step function never touches the fault state and the compiled
+    # graph is IDENTICAL to a build without the subsystem — the faults-off
+    # bit-exactness + zero-overhead contract holds by construction.
+    faults_enabled: bool = False
+    # STATIC schedule capacity (array geometry, part of the jit key):
+    # the scheduled events live in [max_fault_events]-sized traced arrays.
+    max_fault_events: int = 0
+    # STATIC policy selectors: what happens to a dead core's owned
+    # (dirty-conservative) L1 lines — "writeback" keeps them in the LLC
+    # (ownerless), "drop" invalidates the LLC entries too; whether an L1
+    # detected-uncorrectable ECC error escalates to a core fail-stop.
+    fault_dead_policy: str = "writeback"
+    fault_due_failstop: bool = False
+    # TRACED fault knobs (carried into state.FaultState by init_state and
+    # blanked by timing_normalized, exactly like the timing knobs): the
+    # PRNG seed, the scheduled events (step, kind, a, b) — kinds are the
+    # FAULT_* constants above — and the per-site per-step bit-flip /
+    # DUE-classification probabilities. A `sweep --vary fault_seed`
+    # fan-out therefore NEVER recompiles.
+    fault_seed: int = 0
+    fault_events: tuple = ()
+    fault_flip_l1: float = 0.0
+    fault_flip_llc: float = 0.0
+    fault_due_rate: float = 0.0
 
     def __post_init__(self):
         self.validate()
@@ -271,6 +346,82 @@ class MachineConfig:
                 f"sharer_chunk_words={self.sharer_chunk_words} must divide "
                 f"n_sharer_words={self.n_sharer_words}"
             )
+        self._validate_faults()
+
+    def _validate_faults(self) -> None:
+        """Fault-injection knob validation (typed FaultConfigError)."""
+        if self.fault_dead_policy not in ("writeback", "drop"):
+            raise FaultConfigError(
+                f"fault_dead_policy must be 'writeback' or 'drop', got "
+                f"{self.fault_dead_policy!r}",
+                field="fault_dead_policy",
+            )
+        if self.max_fault_events < 0:
+            raise FaultConfigError(
+                "max_fault_events must be >= 0", field="max_fault_events"
+            )
+        for name in ("fault_flip_l1", "fault_flip_llc", "fault_due_rate"):
+            v = getattr(self, name)
+            if not (0.0 <= v <= 1.0):
+                raise FaultConfigError(
+                    f"{name}={v} must be a probability in [0, 1]", field=name
+                )
+        if len(self.fault_events) > self.max_fault_events:
+            raise FaultConfigError(
+                f"{len(self.fault_events)} scheduled events exceed "
+                f"max_fault_events={self.max_fault_events}",
+                field="max_fault_events",
+            )
+        nl = self.n_tiles * 4  # directed links (noc.mesh.n_links)
+        for ev in self.fault_events:
+            if len(ev) != 4:
+                raise FaultConfigError(
+                    f"event {ev!r} must be (step, kind, a, b)",
+                    field="fault_events",
+                )
+            estep, kind, a, b = (int(x) for x in ev)
+            if estep < 0:
+                raise FaultConfigError(
+                    "scheduled step must be >= 0", step=estep,
+                    field="fault_events",
+                )
+            if kind == FAULT_CORE_FAILSTOP:
+                if not (0 <= a < self.n_cores):
+                    raise FaultConfigError(
+                        f"core id {a} out of range [0, {self.n_cores})",
+                        site=f"core:{a}", step=estep, field="fault_events",
+                    )
+                if self.sharer_group > 1:
+                    raise FaultConfigError(
+                        "core fail-stop requires sharer_group == 1: a "
+                        "coarse group bit covers live neighbors, so the "
+                        "dead core's sharer bits cannot be scrubbed "
+                        "without invalidating them too",
+                        site=f"core:{a}", step=estep, field="sharer_group",
+                    )
+            elif kind in (FAULT_LINK_FAIL, FAULT_LINK_DEGRADE):
+                if not (0 <= a < nl):
+                    raise FaultConfigError(
+                        f"link id {a} out of range [0, {nl})",
+                        site=f"link:{a}", step=estep, field="fault_events",
+                    )
+                if self.noc.mesh_x < 2 or self.noc.mesh_y < 2:
+                    raise FaultConfigError(
+                        "link faults need a >= 2x2 mesh (the X-Y fallback "
+                        "detours around the failed hop through an "
+                        "adjacent row/column)",
+                        site=f"link:{a}", step=estep, field="noc",
+                    )
+                if kind == FAULT_LINK_DEGRADE and b < 0:
+                    raise FaultConfigError(
+                        "degrade extra latency must be >= 0",
+                        site=f"link:{a}", step=estep, field="fault_events",
+                    )
+            else:
+                raise FaultConfigError(
+                    f"unknown fault kind {kind}", step=estep,
+                    field="fault_events",
+                )
 
     def timing_normalized(self) -> "MachineConfig":
         """This config with every TRACED timing knob (sim.state.TimingKnobs:
@@ -292,6 +443,14 @@ class MachineConfig:
             ),
             dram_lat=1,
             dram_service=0,
+            # traced fault knobs blank out too (seed/schedule/rates ride
+            # in state.FaultState); the STATIC selectors (faults_enabled,
+            # max_fault_events, policies) survive — they change the graph
+            fault_seed=0,
+            fault_events=(),
+            fault_flip_l1=0.0,
+            fault_flip_llc=0.0,
+            fault_due_rate=0.0,
         )
 
     # Derived geometry used by both engines --------------------------------
@@ -333,6 +492,10 @@ class MachineConfig:
             d["llc"] = CacheConfig(**d["llc"])
         if "noc" in d and isinstance(d["noc"], dict):
             d["noc"] = NocConfig(**d["noc"])
+        if "fault_events" in d and d["fault_events"] is not None:
+            d["fault_events"] = tuple(
+                tuple(int(x) for x in ev) for ev in d["fault_events"]
+            )
         return MachineConfig(**d)
 
     @staticmethod

@@ -1,0 +1,28 @@
+"""Deterministic, seeded fault injection for the SIMULATED machine
+(DESIGN.md §12).
+
+Three architectural fault classes, all fully TRACED so fleet sweeps still
+compile once per geometry and `sweep --vary fault_seed` never recompiles:
+
+- core fail-stop at a scheduled step (the dead core leaves the quantum
+  barrier, its directory footprint is scrubbed, its owned lines are
+  written back or dropped per policy);
+- mesh link failure/degradation (failed hops take an X-Y fallback detour
+  with extra latency, counted as rerouted messages);
+- transient L1/LLC bit flips under a SECDED ECC model (corrected vs
+  detected-uncorrectable counters; DUE optionally escalates to a
+  fail-stop).
+
+Randomness is a counter-based PRNG keyed on (seed, step, site) — no host
+RNG, no traced RNG state — so the same schedule replays bit-exactly solo,
+fleet-vmapped, and across checkpoint/resume (the supervisor's chaos mode
+rides the PR 3 guard/checkpoint machinery unchanged).
+"""
+
+from .prng import fmix32, site_hash, site_hash_np  # noqa: F401
+from .schedule import (  # noqa: F401
+    FaultSchedule,
+    FaultState,
+    fault_state_from_config,
+    load_schedule,
+)

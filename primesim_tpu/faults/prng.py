@@ -1,0 +1,70 @@
+"""Counter-based fault PRNG: hash (seed, step, site) -> uniform uint32.
+
+No host randomness and no traced RNG state: every draw is a pure function
+of the simulation seed, the step number, and a site id, so a schedule
+replays bit-exactly solo vs fleet-vmapped vs resumed-from-checkpoint, and
+the fleet's batch axis vmaps through it like any other arithmetic.
+
+The mixer is the murmur3 fmix32 finalizer — full avalanche on 32 bits —
+over a Weyl-style combination of the inputs. A draw fires an event of
+probability p when `hash < threshold(p)` with threshold = round(p * 2^32)
+saturated to uint32 (p=0 never fires; p=1 misses only the single all-ones
+hash value, error 2^-32).
+
+`site_hash_np` is the NumPy twin used by tests to predict device draws.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# distinct odd constants decorrelate the step and site counters
+_STEP_MUL = 0x9E3779B9
+_SITE_MUL = 0x85EBCA77
+#: salt for the second (DUE-classification) draw per site
+DUE_SALT = 0x2545F491
+
+
+def fmix32(x):
+    """murmur3 32-bit finalizer (jnp uint32 in/out)."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def site_hash(seed, step, site, salt: int = 0):
+    """Uniform uint32 draw for (seed, step, site). `seed` a traced uint32
+    scalar, `step` a traced int32 scalar, `site` an int32 array."""
+    x = (
+        seed.astype(jnp.uint32)
+        ^ jnp.uint32(salt)
+        ^ (step.astype(jnp.uint32) * jnp.uint32(_STEP_MUL))
+        ^ (site.astype(jnp.uint32) * jnp.uint32(_SITE_MUL))
+    )
+    return fmix32(x)
+
+
+def site_hash_np(seed: int, step, site, salt: int = 0) -> np.ndarray:
+    """Host-side reference of `site_hash` (bit-identical)."""
+    with np.errstate(over="ignore"):
+        x = (
+            np.uint32(seed)
+            ^ np.uint32(salt)
+            ^ (np.asarray(step, np.uint32) * np.uint32(_STEP_MUL))
+            ^ (np.asarray(site, np.uint32) * np.uint32(_SITE_MUL))
+        )
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(0x85EBCA6B)
+        x = x ^ (x >> np.uint32(13))
+        x = x * np.uint32(0xC2B2AE35)
+        x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def prob_threshold(p: float) -> np.uint32:
+    """Probability -> uint32 compare threshold (fires when hash < t)."""
+    return np.uint32(min(0xFFFFFFFF, int(round(float(p) * 4294967296.0))))

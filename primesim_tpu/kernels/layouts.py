@@ -27,6 +27,13 @@ Kernels must NOT derive core ids from `pl.program_id`: the fleet engine
 vmaps the whole step, and the Pallas batching rule prepends a grid axis,
 which would silently renumber the blocks. Global core ids arrive as a
 [BC, 1] input instead (`sharer_reductions` set the pattern).
+
+These layouts are also the reason fault injection (DESIGN.md §12) never
+touches kernel code: fault effects are expressed entirely on the staged
+operands (a pre-gather `dirm` scrub, lane-predicate masking, post-fold
+latency/counter addends), and the counter fold is width-generic over
+`counters.shape[0]` — adding the fault counters changed no block spec.
+See the FAULT-LANE CONTRACT note in step_kernels.py.
 """
 
 from __future__ import annotations

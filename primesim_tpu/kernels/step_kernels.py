@@ -27,6 +27,20 @@ including coarse-directory and fleet-vmapped paths). Core ids arrive as
 a [BC, 1] input — never pl.program_id — so jax.vmap batching (the fleet
 engine) stays correct, and traced step scalars ride as (1, 1) blocks so
 timing sweeps never recompile.
+
+FAULT-LANE CONTRACT (DESIGN.md §12). Fault injection is deliberately
+IMPLEMENTATION-AGNOSTIC: every architectural fault effect lands outside
+the kernel fusion boundary, so `step_impl=pallas` and `step_impl=xla`
+see byte-identical operands and need no fault-specific code paths.
+Concretely: the fail-stop directory scrub rewrites `dirm` BEFORE the
+phase-1 row gathers stage it; dead cores are removed from the lane
+predicates (`countable`/`active`/local-run `pref`) that gate what these
+kernels classify and commit; NoC detour latencies and reroute/ECC
+counter deltas are added to the composed per-lane latencies and counter
+fold AFTER `commit_step` returns (the fold derives its width from
+`counters.shape[0]`, so the four fault counters flow through the stacked
+fold untouched). A faults-off config reaches these kernels with bit-
+identical inputs to a build without the fault subsystem at all.
 """
 
 from __future__ import annotations

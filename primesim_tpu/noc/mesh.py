@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import functools
 
+import jax.numpy as jnp
+
 from ..config.machine import MachineConfig
 
 
@@ -68,3 +70,59 @@ def xy_links(a: int, b: int, mesh_x: int) -> tuple[int, ...]:
         links.append((y * mesh_x + x) * 4 + d)
         y += 1 if by > y else -1
     return tuple(links)
+
+
+def path_links(cfg: MachineConfig, a, b):
+    """Vectorized XY route a->b as directed link ids, -1-padded to the
+    mesh diameter — link-for-link identical to `xy_links` (x phase at the
+    source row, then y phase at the destination column). Shared by the
+    engine's per-link contention models and the fault-injection detour
+    model (faults/inject.py)."""
+    mx, my = cfg.noc.mesh_x, cfg.noc.mesh_y
+    H = max(1, (mx - 1) + (my - 1))
+    ax, ay = a % mx, a // mx
+    bx, by = b % mx, b // mx
+    i = jnp.arange(H, dtype=jnp.int32)[None, :]
+    sx = jnp.sign(bx - ax)
+    nx = jnp.abs(bx - ax)
+    px = ax[:, None] + sx[:, None] * i
+    xlink = (ay[:, None] * mx + px) * 4 + jnp.where(sx[:, None] > 0, 0, 1)
+    sy = jnp.sign(by - ay)
+    ny = jnp.abs(by - ay)
+    j = i - nx[:, None]
+    py = ay[:, None] + sy[:, None] * j
+    ylink = (py * mx + bx[:, None]) * 4 + jnp.where(sy[:, None] > 0, 2, 3)
+    return jnp.where(
+        i < nx[:, None], xlink, jnp.where(j < ny[:, None], ylink, -1)
+    )
+
+
+# ---- fault-model detour (DESIGN.md §12) -----------------------------------
+# A FAILED directed link on a message's XY path forces an adaptive
+# fallback around it: one orthogonal sidestep and return, i.e. +2 hops and
+# +2 * (link_lat + router_lat) cycles per failed hop (the minimal X-Y
+# detour around a single dead edge of a >= 2x2 mesh; config validation
+# rejects link faults on thinner meshes). A DEGRADED (alive) link adds its
+# `extra` cycles each traversal; a dead link's extra is moot (the detour
+# replaces the traversal). `detour_stats` is the scalar reference the
+# vectorized `faults.inject.leg_fault_penalty` must match per leg.
+
+
+def detour_stats(
+    a: int, b: int, mesh_x: int, link_dead, link_extra,
+    link_lat: int, router_lat: int,
+) -> tuple[int, int, int]:
+    """Scalar fault penalty of the one-way leg a -> b: (extra cycles,
+    extra hops, rerouted flag)."""
+    dead = 0
+    extra = 0
+    for l in xy_links(a, b, mesh_x):
+        if link_dead[l]:
+            dead += 1
+        else:
+            extra += int(link_extra[l])
+    return (
+        dead * 2 * (link_lat + router_lat) + extra,
+        2 * dead,
+        int(dead > 0),
+    )

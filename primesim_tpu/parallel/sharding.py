@@ -30,6 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..faults.schedule import FaultState
 from ..sim.state import MachineState, TimingKnobs
 
 AXIS = "tiles"
@@ -81,6 +82,22 @@ def state_pspecs() -> MachineState:
             dram_lat=P(),
             dram_service=P(),
             contention_lat=P(),
+        ),
+        # fault state: the per-core dead mask shards with the cores it
+        # gates; link masks and the (tiny) schedule arrays replicate like
+        # the link/lock tables above
+        faults=FaultState(
+            seed=P(),
+            core_dead=P(AXIS),
+            link_dead=P(),
+            link_extra=P(),
+            ev_step=P(),
+            ev_kind=P(),
+            ev_a=P(),
+            ev_b=P(),
+            flip_l1=P(),
+            flip_llc=P(),
+            due_rate=P(),
         ),
     )
 

@@ -31,13 +31,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.machine import MachineConfig
+from ..faults.schedule import FaultState
 from ..stats.counters import COUNTER_NAMES
 from .state import MachineState, TimingKnobs
 
-_FORMAT = 4  # v3: fused dirm row (metadata + sharers) replaces
+_FORMAT = 5  # v3: fused dirm row (metadata + sharers) replaces
 # llc_meta/sharers; 5-plane l1; link_free/dram_free queue clocks.
 # v4: nested TimingKnobs state field (flattened to state_knobs__<name>
 # keys — npz holds flat arrays only).
+# v5: nested FaultState field (state_faults__<name>) + four fault
+# counters — resuming a chaos run replays the surviving schedule and
+# dead-core/link masks bit-exactly.
+
+# nested-NamedTuple state fields and their types (flattened by
+# _state_arrays to `state_<field>__<sub>` keys; extend here when a new
+# nested pytree joins MachineState)
+_NESTED = {"knobs": TimingKnobs, "faults": FaultState}
 
 _CRC_KEY = "crc_json"  # reserved npz member: {array name: crc32} manifest
 
@@ -134,10 +143,11 @@ def load_verified_npz(path: str) -> dict[str, np.ndarray]:
 
 def _state_arrays(st: MachineState) -> dict[str, np.ndarray]:
     """Flatten the state pytree to npz-storable arrays: plain fields as
-    `state_<name>`, the nested knobs as `state_knobs__<name>`."""
+    `state_<name>`, nested NamedTuples (_NESTED) as
+    `state_<name>__<sub>`."""
     arrays = {}
     for k, v in st._asdict().items():
-        if isinstance(v, TimingKnobs):
+        if isinstance(v, tuple(_NESTED.values())):
             for kk, vv in v._asdict().items():
                 arrays[f"state_{k}__{kk}"] = np.asarray(vv)
         else:
@@ -146,15 +156,16 @@ def _state_arrays(st: MachineState) -> dict[str, np.ndarray]:
 
 
 def _state_from(z) -> MachineState:
-    """Rebuild a MachineState from a v4 npz (inverse of _state_arrays)."""
+    """Rebuild a MachineState from a v5 npz (inverse of _state_arrays)."""
     fields = {}
     for k in MachineState._fields:
         # nested-pytree fields are flattened, so the flat key is absent
-        if f"state_{k}" not in z:
-            fields[k] = TimingKnobs(
+        if k in _NESTED:
+            typ = _NESTED[k]
+            fields[k] = typ(
                 **{
                     kk: jnp.asarray(z[f"state_{k}__{kk}"])
-                    for kk in TimingKnobs._fields
+                    for kk in typ._fields
                 }
             )
         else:

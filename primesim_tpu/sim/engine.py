@@ -106,28 +106,10 @@ def _one_way(tile_a, tile_b, cfg: MachineConfig, kn):
     return h * kn.link_lat + (h + 1) * kn.router_lat, h
 
 
-def _path_links(cfg: MachineConfig, a, b):
-    """Vectorized XY route a->b as directed link ids, -1-padded to the
-    mesh diameter — link-for-link identical to noc.mesh.xy_links (x phase
-    at the source row, then y phase at the destination column; link id =
-    tile*4 + dir with dir 0=E, 1=W, 2=N, 3=S)."""
-    mx, my = cfg.noc.mesh_x, cfg.noc.mesh_y
-    H = max(1, (mx - 1) + (my - 1))
-    ax, ay = a % mx, a // mx
-    bx, by = b % mx, b // mx
-    i = jnp.arange(H, dtype=jnp.int32)[None, :]
-    sx = jnp.sign(bx - ax)
-    nx = jnp.abs(bx - ax)
-    px = ax[:, None] + sx[:, None] * i
-    xlink = (ay[:, None] * mx + px) * 4 + jnp.where(sx[:, None] > 0, 0, 1)
-    sy = jnp.sign(by - ay)
-    ny = jnp.abs(by - ay)
-    j = i - nx[:, None]
-    py = ay[:, None] + sy[:, None] * j
-    ylink = (py * mx + bx[:, None]) * 4 + jnp.where(sy[:, None] > 0, 2, 3)
-    return jnp.where(
-        i < nx[:, None], xlink, jnp.where(j < ny[:, None], ylink, -1)
-    )
+# vectorized XY route builder (link id = tile*4 + dir, dir 0=E 1=W 2=N
+# 3=S), shared with the fault-injection detour model — lives in noc.mesh
+# next to its scalar reference `xy_links`
+from ..noc.mesh import path_links as _path_links  # noqa: E402
 
 
 def _l1_probe(cfg: MachineConfig, arange_c, l1, dirm, line,
@@ -290,6 +272,52 @@ def step(
 
     cnt = st.counters
 
+    # ---- phase -1: fault injection (DESIGN.md §12) -----------------------
+    # STATIC gate: faults-off programs contain none of this — the faults
+    # pytree passes through untouched and the step graph is the pre-fault
+    # one (the bit-exact / zero-overhead contract). Faults-on, everything
+    # is TRACED (schedule arrays, counter-based PRNG on (seed, step,
+    # site)) so one compiled program serves every seed and schedule of a
+    # geometry, and the fleet vmaps straight through it.
+    if cfg.faults_enabled:
+        from ..faults.inject import ecc_step, fire_events, scrub_dead_cond
+
+        fsf = st.faults
+        # only cores that haven't retired END absorb faults: a finished
+        # core is powered down, and — critically for the solo-vs-fleet
+        # determinism contract — a fleet element keeps stepping after it
+        # completes (until the whole batch drains), so any fault counted
+        # on an ended core would diverge from the same element run solo
+        p_end = jnp.minimum(st.ptr, T - 1)
+        alive0 = (events[arange_c, p_end, 0] != EV_END) & (
+            fsf.core_dead == 0
+        )
+        kill_sched, link_dead_n, link_extra_n = fire_events(
+            cfg, fsf, st.step
+        )
+        ecc_corr, ecc_due, l1_due = ecc_step(cfg, fsf, st.step, arange_c)
+        kill_new = kill_sched
+        if cfg.fault_due_failstop:
+            # an uncorrectable error in a core's private cache is fatal
+            # to that core (machine-check fail-stop)
+            kill_new = kill_new | l1_due.astype(jnp.int32)
+        kill_now = kill_new * alive0.astype(jnp.int32)
+        cnt = cadd(cnt, "core_failstops", kill_now)
+        cnt = cadd(cnt, "ecc_corrected", jnp.where(alive0, ecc_corr, 0))
+        cnt = cadd(cnt, "ecc_due", jnp.where(alive0, ecc_due, 0))
+        dirm_f, lockh_f, wb_dead = scrub_dead_cond(
+            cfg, st.dirm, st.lock_holder, kill_now
+        )
+        if cfg.fault_dead_policy == "writeback":
+            cnt = cadd(cnt, "l1_writebacks", wb_dead)
+        fsf = fsf._replace(
+            core_dead=fsf.core_dead | kill_now,
+            link_dead=link_dead_n,
+            link_extra=link_extra_n,
+        )
+        st = st._replace(dirm=dirm_f, lock_holder=lockh_f, faults=fsf)
+        deadb = fsf.core_dead != 0  # [C] — dead cores leave every mask
+
     # ---- phase 0: quantum barrier (on step-entry state) ------------------
     # Barrier-frozen cores (arrived, waiting for release) neither bump nor
     # bound the quantum (DESIGN.md §3): they rejoin at release. With local
@@ -305,6 +333,10 @@ def step(
         p0 = jnp.minimum(st.ptr, T - 1)
         et0 = events[arange_c, p0, 0]
     countable0 = (et0 != EV_END) & ~((et0 == EV_BARRIER) & (st.sync_flag != 0))
+    if cfg.faults_enabled:
+        # a fail-stopped core neither bumps nor bounds the quantum — it
+        # leaves the barrier instead of deadlocking it
+        countable0 = countable0 & ~deadb
     any_countable = jnp.any(countable0)
     any_active = jnp.any(countable0 & (st.cycles < st.quantum_end))
     min_nd = jnp.min(jnp.where(countable0, st.cycles, INT32_MAX))
@@ -438,6 +470,8 @@ def step(
         hit_k = r_hit_k | w_hit_k
         local_k = is_ins_k | hit_k  # END/sync/miss candidates stop the run
         pref = jnp.cumprod(local_k.astype(jnp.int32), axis=1) != 0
+        if cfg.faults_enabled:
+            pref = pref & ~deadb[:, None]  # dead cores retire nothing
         cost_k = jnp.where(
             is_ins_k,
             eargr * cpi_vec[:, None],
@@ -540,6 +574,8 @@ def step(
     not_done = et != EV_END
     frozen = (et == EV_BARRIER) & (st.sync_flag != 0)
     active = not_done & ~frozen & (cycles_c < quantum_end)
+    if cfg.faults_enabled:
+        active = active & ~deadb
 
     is_ins = active & (et == EV_INS)
     is_st_ev = et == EV_ST
@@ -659,6 +695,25 @@ def step(
     btile = bank % n_tiles
     req_lat, req_hops = _one_way(ctile, btile, cfg, kn)
     rep_lat, rep_hops = _one_way(btile, ctile, cfg, kn)
+    if cfg.faults_enabled:
+        # link-fault penalties of the request/reply legs (detour around
+        # dead links + degrade extras — faults/inject.py). The NOMINAL
+        # legs are left untouched through the service/contention math:
+        # the router model's `extra_home = raw_rt - (req_lat + service +
+        # rep_lat)` decomposition and the link/tile contention counts are
+        # all defined on the nominal XY path (a detour adds latency, it
+        # does not re-route the contention walk), so the fault extras
+        # join the composed latencies AFTER that block, and the hop
+        # counters bump just before the counter fold.
+        from ..faults.inject import leg_fault_penalty
+
+        fx_req, fh_req, rr_req = leg_fault_penalty(
+            cfg, st.faults, kn, ctile, btile
+        )
+        fx_rep, fh_rep, rr_rep = leg_fault_penalty(
+            cfg, st.faults, kn, btile, ctile
+        )
+        flt_rt = fx_req + fx_rep  # round-trip fault extra, home txns
 
     # barrier home tile (bid lives in the addr field; ids validated
     # < barrier_slots at ingest) — shared by the contention count and the
@@ -731,6 +786,16 @@ def step(
     oclamp = jnp.maximum(owner, 0)
     otile = oclamp % n_tiles
     po_lat, po_hops = _one_way(btile, otile, cfg, kn)  # bank -> owner (symmetric back)
+    if cfg.faults_enabled:
+        # probe legs keep the analytic model's symmetric round-trip shape
+        # (2 * po_lat): the forward-leg fault penalty is charged both
+        # ways. Safe to bump in place — nothing downstream decomposes the
+        # probe leg the way the router block decomposes req/rep.
+        fx_po, fh_po, rr_po = leg_fault_penalty(
+            cfg, st.faults, kn, btile, otile
+        )
+        po_lat = po_lat + fx_po
+        po_hops = po_hops + fh_po
 
     is_write_req = getm | upg
     gets_w = gets & winner
@@ -1114,6 +1179,16 @@ def step(
         lat_join = (
             l1_lat + req_lat + llc_lat + rep_lat + extra_home
         )
+    if cfg.faults_enabled:
+        # detour/degrade extras of the request+reply legs join the
+        # composed round trip here (see the leg computation above); the
+        # hop counts bump with their detours for the counter fold and the
+        # phase-2.7 lock legs, now that the router walk is done with the
+        # nominal values
+        lat = lat + flt_rt
+        lat_join = lat_join + flt_rt
+        req_hops = req_hops + fh_req
+        rep_hops = rep_hops + fh_rep
     ov = cfg.core.o3_overlap_256
     if ov:
         lat = lat - ((lat * ov) >> 8)
@@ -1155,6 +1230,16 @@ def step(
     )
     cnt = cadd(cnt, "noc_msgs", noc_msgs)
     cnt = cadd(cnt, "noc_hops", noc_hops)
+    if cfg.faults_enabled:
+        # rerouted messages: one-way legs whose XY path crossed a dead
+        # link (invalidation fan-outs keep their analytic group/pair
+        # latencies — model scope, like the router walk's)
+        cnt = cadd(
+            cnt,
+            "noc_reroutes",
+            jnp.where(winner | join, rr_req + rr_rep, 0)
+            + jnp.where(probe_any, 2 * rr_po, 0),
+        )
 
     # ---- phase 4.A: local updates ----------------------------------------
     # retire + clock advance (memory events also charge their pre-batched
@@ -1466,6 +1551,10 @@ def step(
             lat_rt = raw_rt
         else:
             lat_rt = lreq_lat + llc_lat + lrep_lat + extra_home
+        if cfg.faults_enabled:
+            # lock/unlock RMWs ride the same core<->home-bank legs as the
+            # memory path: same round-trip fault extra
+            lat_rt = lat_rt + flt_rt
 
         # unlocks: every unlock is a charged RMW round trip to the lock's
         # home; the slot is released only if this core actually holds it
@@ -1510,6 +1599,12 @@ def step(
         cnt = cadd(cnt, "lock_spins", spin)
         cnt = cadd(cnt, "noc_msgs", jnp.where(is_lock, 2, 0))
         cnt = cadd(cnt, "noc_hops", jnp.where(is_lock, lreq_hops + lrep_hops, 0))
+        if cfg.faults_enabled:
+            cnt = cadd(
+                cnt,
+                "noc_reroutes",
+                jnp.where(is_unlock | is_lock, rr_req + rr_rep, 0),
+            )
         lock_holder = lock_holder.at[jnp.where(grant, lslot, L)].set(
             arange_c, mode="drop"
         )
@@ -1522,6 +1617,18 @@ def step(
         barr_lat, barr_hops = _one_way(ctile, htile, cfg, kn)
         wake_lat, wake_hops = _one_way(htile, ctile, cfg, kn)
         barr_charge = raw_arr if router else barr_lat + extra_bar
+        if cfg.faults_enabled:
+            # barrier arrival and wake-up legs detour like any message
+            fx_arr, fh_arr, rr_arr = leg_fault_penalty(
+                cfg, st.faults, kn, ctile, htile
+            )
+            fx_wk, fh_wk, rr_wk = leg_fault_penalty(
+                cfg, st.faults, kn, htile, ctile
+            )
+            barr_charge = barr_charge + fx_arr
+            barr_hops = barr_hops + fh_arr
+            wake_lat = wake_lat + fx_wk
+            wake_hops = wake_hops + fh_wk
         cycles = cycles + jnp.where(
             is_barrier, epre * cpi_vec + barr_charge, 0
         )
@@ -1529,6 +1636,10 @@ def step(
         cnt = cadd(cnt, "barrier_waits", is_barrier)
         cnt = cadd(cnt, "noc_msgs", is_barrier)
         cnt = cadd(cnt, "noc_hops", jnp.where(is_barrier, barr_hops, 0))
+        if cfg.faults_enabled:
+            cnt = cadd(
+                cnt, "noc_reroutes", jnp.where(is_barrier, rr_arr, 0)
+            )
         sync_flag = jnp.where(is_barrier, 1, sync_flag)
         barrier_count = barrier_count.at[
             jnp.where(is_barrier, bid, BS)
@@ -1543,11 +1654,34 @@ def step(
         # unchanged this step (frozen lanes retire nothing), so the phase-0.9
         # gather is still current for them.
         wait_m = (et == EV_BARRIER) & (sync_flag == 1)
-        released = wait_m & (barrier_count[bid] >= earg)
+        if cfg.faults_enabled:
+            # fail-stop barrier relief (DESIGN.md §12): a dead core will
+            # never arrive, so waiters must not require its arrival — the
+            # barrier twin of the dead-holder lock release above. A dead
+            # core ALREADY counted in a slot (it arrived, froze, then
+            # died) still satisfies its own arrival, so it grants no
+            # relief there. Like the lock idealization this is a recovery
+            # semantics choice: exact for global barriers; a subset
+            # barrier is relieved even by a dead non-participant (the
+            # trace encodes participant COUNTS, not sets) — chaos mode
+            # favors forward progress over subset fidelity.
+            dead_counted = (
+                jnp.zeros(BS, jnp.int32)
+                .at[jnp.where(wait_m & deadb, bid, BS)]
+                .add(1, mode="drop")
+            )
+            missing = jnp.sum(deadb.astype(jnp.int32)) - dead_counted[bid]
+            released = wait_m & (barrier_count[bid] + missing >= earg)
+        else:
+            released = wait_m & (barrier_count[bid] >= earg)
         cycles = jnp.where(released, barrier_time[bid] + wake_lat, cycles)
         cnt = cadd(cnt, "instructions", released)
         cnt = cadd(cnt, "noc_msgs", released)
         cnt = cadd(cnt, "noc_hops", jnp.where(released, wake_hops, 0))
+        if cfg.faults_enabled:
+            cnt = cadd(
+                cnt, "noc_reroutes", jnp.where(released, rr_wk, 0)
+            )
         sync_flag = jnp.where(released, 0, sync_flag)
         ptr = ptr + released.astype(jnp.int32)
         nrel = (
@@ -1593,6 +1727,9 @@ def step(
         step=step_no + 1,
         counters=counters_final,
         knobs=kn,
+        # post-injection fault state (phase -1 rebound `st`); faults-off
+        # this is the untouched input pytree
+        faults=st.faults,
     )
 
 
@@ -1624,10 +1761,15 @@ def _np(x) -> np.ndarray:
     return np.asarray(x)
 
 
-def _device_done(events, st, arange_c):
+def _device_done(events, st, arange_c, faults_enabled=False):
     T = events.shape[1]
     p = jnp.minimum(st.ptr, T - 1)
-    return jnp.all(events[arange_c, p, 0] == EV_END)
+    done = events[arange_c, p, 0] == EV_END
+    if faults_enabled:
+        # a fail-stopped core never reaches its END marker; it is done by
+        # decree, so a run with injected fail-stops still terminates
+        done = done | (st.faults.core_dead != 0)
+    return jnp.all(done)
 
 
 def _drain_and_rebase(cfg, st, acc_lo, acc_hi, base_lo, base_hi, nd):
@@ -1693,7 +1835,9 @@ def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
 
     def cond(carry):
         st, acc_lo, acc_hi, base_lo, base_hi, k = carry
-        return (k < max_chunks) & ~_device_done(events, st, arange_c)
+        return (k < max_chunks) & ~_device_done(
+            events, st, arange_c, cfg.faults_enabled
+        )
 
     def body(carry):
         st, acc_lo, acc_hi, base_lo, base_hi, k = carry
@@ -1704,6 +1848,11 @@ def run_loop(cfg: MachineConfig, chunk_steps: int, events, st: MachineState,
         st, _ = jax.lax.scan(sbody, st, None, length=chunk_steps)
         p = jnp.minimum(st.ptr, T - 1)
         nd = events[arange_c, p, 0] != EV_END
+        if cfg.faults_enabled:
+            # dead cores must not bound the rebase minimum: their frozen
+            # clocks would pin delta at 0 forever (int32 overflow risk on
+            # long post-fault runs)
+            nd = nd & (st.faults.core_dead == 0)
         st, acc_lo, acc_hi, base_lo, base_hi = _drain_and_rebase(
             cfg, st, acc_lo, acc_hi, base_lo, base_hi, nd
         )
@@ -1746,7 +1895,13 @@ def stream_loop(cfg: MachineConfig, events, st: MachineState, exhausted,
 
     def at_end(s):
         p = jnp.minimum(s.ptr, T - 1)
-        return events[arange_c, p, 0] == EV_END
+        done = events[arange_c, p, 0] == EV_END
+        if cfg.faults_enabled:
+            # defensive only — the CLI rejects streaming + faults (the
+            # window prefetcher cannot know a core died mid-window), but
+            # the device loop must still terminate if reached directly
+            done = done | (s.faults.core_dead != 0)
+        return done
 
     def cond(carry):
         st, acc_lo, acc_hi, base_lo, base_hi, k = carry
@@ -1858,9 +2013,15 @@ class Engine:
         p = np.minimum(_np(self.state.ptr), self.trace.max_len - 1)
         return self.trace.events[np.arange(self.cfg.n_cores), p, 0]
 
+    def _dead_mask(self) -> np.ndarray:
+        """[C] bool — fail-stopped cores (all-False with faults off)."""
+        if self.cfg.faults_enabled:
+            return _np(self.state.faults.core_dead) != 0
+        return np.zeros(self.cfg.n_cores, bool)
+
     def _rebase(self) -> None:
         cyc = _np(self.state.cycles)
-        nd = self._event_types_at_ptr() != EV_END
+        nd = (self._event_types_at_ptr() != EV_END) & ~self._dead_mask()
         if not nd.any():
             return
         delta = (int(cyc[nd].min()) // self.cfg.quantum) * self.cfg.quantum
@@ -1890,21 +2051,26 @@ class Engine:
         )
 
     def done(self) -> bool:
-        return bool((self._event_types_at_ptr() == EV_END).all())
+        return bool(self.done_mask().all())
 
     def done_mask(self) -> np.ndarray:
-        """[C] bool — cores whose trace pointer sits on END."""
-        return self._event_types_at_ptr() == EV_END
+        """[C] bool — cores whose trace pointer sits on END, plus fail-
+        stopped cores (dead by injected fault — they will never reach
+        END, so completion means 'everyone else finished')."""
+        return (self._event_types_at_ptr() == EV_END) | self._dead_mask()
 
     def live_mask(self) -> np.ndarray:
-        """[C] bool — cores that bound the quantum window: not at END and
+        """[C] bool — cores that bound the quantum window: not at END,
         not frozen at a barrier (a frozen core's clock legally lags
         `quantum_end` until release, mirroring the `countable` mask in
-        step() phase 0). Input to the supervisor's clock-window guard
-        (validate.check_chunk_invariants)."""
+        step() phase 0), and not fail-stopped by an injected fault (a
+        dead core's clock freezes at its death step). Input to the
+        supervisor's clock-window guard (validate.check_chunk_invariants)
+        — this exclusion is what keeps `--guard=fail` from false-
+        positiving on intentionally injected faults."""
         et = self._event_types_at_ptr()
         frozen = (et == EV_BARRIER) & (_np(self.state.sync_flag) != 0)
-        return (et != EV_END) & ~frozen
+        return (et != EV_END) & ~frozen & ~self._dead_mask()
 
     def run(self, max_steps: int = 10_000_000) -> None:
         """Run to completion in ONE device dispatch (preferred path).
@@ -1981,9 +2147,7 @@ class Engine:
         (host-side; raises AssertionError naming the violation)."""
         from .validate import check_invariants
 
-        check_invariants(
-            self.cfg, self.state, done_mask=self._event_types_at_ptr() == EV_END
-        )
+        check_invariants(self.cfg, self.state, done_mask=self.done_mask())
 
     # ---- checkpoint / resume (SURVEY.md §5.4) ----------------------------
 

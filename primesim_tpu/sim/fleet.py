@@ -48,7 +48,9 @@ from .engine import _ACC_BITS, _np, run_chunk, run_loop
 from .state import MachineState, init_state
 
 #: Override keys `apply_overrides` accepts — the TimingKnobs fields, named
-#: as a user would write them in a sweep spec.
+#: as a user would write them in a sweep spec, plus `fault_seed` (not a
+#: TimingKnob — it seeds the traced FaultState — but traced all the same,
+#: so `sweep --vary fault_seed` shares one compilation per geometry).
 KNOB_KEYS = (
     "quantum",
     "cpi",
@@ -59,6 +61,7 @@ KNOB_KEYS = (
     "dram_lat",
     "dram_service",
     "contention_lat",
+    "fault_seed",
 )
 
 
@@ -111,6 +114,8 @@ def apply_overrides(cfg: MachineConfig, ov: dict | None) -> MachineConfig:
         out = dataclasses.replace(out, dram_lat=int(ov["dram_lat"]))
     if "dram_service" in ov:
         out = dataclasses.replace(out, dram_service=int(ov["dram_service"]))
+    if "fault_seed" in ov:
+        out = dataclasses.replace(out, fault_seed=int(ov["fault_seed"]))
     if out.quantum * out.n_cores >= 2**31:
         raise ValueError(
             "quantum * n_cores must be < 2^31 (conflict-key packing); "
@@ -284,30 +289,38 @@ class FleetEngine:
             np.arange(B)[:, None], np.arange(C)[None, :], p, 0
         ]
 
+    def _dead_mask(self) -> np.ndarray:
+        """[B, C] bool — fail-stopped cores (all-False with faults off);
+        same contract as Engine._dead_mask, batched."""
+        if self.cfg.faults_enabled:
+            return _np(self.state.faults.core_dead) != 0
+        return np.zeros((self.n_elements, self.cfg.n_cores), bool)
+
     def done_mask(self) -> np.ndarray:
-        return (self._event_types_at_ptr() == EV_END).all(axis=1)
+        return self.core_done_mask().all(axis=1)
 
     def done(self) -> bool:
         return bool(self.done_mask().all())
 
     def core_done_mask(self) -> np.ndarray:
-        """[B, C] bool — per-element per-core END mask (guard input)."""
-        return self._event_types_at_ptr() == EV_END
+        """[B, C] bool — per-element per-core END-or-dead mask (guard
+        input; a fail-stopped core never reaches END)."""
+        return (self._event_types_at_ptr() == EV_END) | self._dead_mask()
 
     def live_mask(self) -> np.ndarray:
         """[B, C] bool — cores bounding each element's quantum window:
-        not at END, not frozen at a barrier (same contract as
-        Engine.live_mask, batched)."""
+        not at END, not frozen at a barrier, not fail-stopped (same
+        contract as Engine.live_mask, batched)."""
         et = self._event_types_at_ptr()
         frozen = (et == EV_BARRIER) & (_np(self.state.sync_flag) != 0)
-        return (et != EV_END) & ~frozen
+        return (et != EV_END) & ~frozen & ~self._dead_mask()
 
     def _rebase(self) -> None:
         """Per-element host rebase (run_steps path; `run` rebases on
         device): shift each live element's epoch-relative clocks down by
         a multiple of ITS quantum."""
         cyc = _np(self.state.cycles)  # [B, C]
-        nd = self._event_types_at_ptr() != EV_END
+        nd = (self._event_types_at_ptr() != EV_END) & ~self._dead_mask()
         quanta = np.asarray([c.quantum for c in self.elem_cfgs], np.int64)
         m = np.where(nd, cyc, np.iinfo(np.int32).max).min(axis=1)
         delta = np.where(nd.any(axis=1), (m // quanta) * quanta, 0)

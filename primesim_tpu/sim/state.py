@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.machine import MachineConfig
+from ..faults.schedule import FaultState, fault_state_from_config
 from ..stats.counters import COUNTER_NAMES
 
 # MESI encoding (shared with primesim_tpu.golden.sim)
@@ -149,6 +150,13 @@ class MachineState(NamedTuple):
     # through a run (step passes them through), but TRACED so one
     # compiled program serves every timing variant of one geometry
     knobs: TimingKnobs
+    # traced fault-injection state (faults.schedule.FaultState): seed,
+    # schedule arrays, ECC thresholds, and the evolving dead-core/link
+    # masks. Always present so the pytree structure is config-stable;
+    # with cfg.faults_enabled == False (static) step() never reads it —
+    # the faults-off step graph carries the leaves through untouched,
+    # keeping it bit-exact vs the goldens at ~zero overhead
+    faults: FaultState
 
 
 def init_state(cfg: MachineConfig) -> MachineState:
@@ -191,6 +199,7 @@ def init_state(cfg: MachineConfig) -> MachineState:
         step=jnp.asarray(0, jnp.int32),
         counters=jnp.zeros((len(COUNTER_NAMES), C), jnp.int32),
         knobs=knobs_from_config(cfg),
+        faults=fault_state_from_config(cfg),
     )
 
 

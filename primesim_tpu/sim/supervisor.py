@@ -36,6 +36,11 @@ chunk by committed chunk with:
   element (trace loadable, core count, overrides, barrier ids) BEFORE
   batching and quarantines bad ones with their typed error, so one
   malformed element costs one JSON line, not the whole sweep.
+- **chaos mode** — when the wrapped config arms ARCHITECTURAL fault
+  injection (primesim_tpu.faults, DESIGN.md §12) the supervisor logs
+  the armed schedule and every fault-counter movement at chunk
+  boundaries; snapshots carry the fault state (checkpoint format v5),
+  so a chaos run preempted mid-fault resumes bit-exactly.
 """
 
 from __future__ import annotations
@@ -202,6 +207,12 @@ class RunSupervisor:
         self._prev_totals: dict[str, int] | None = None
         self._cpu_fallback_done = False
         self._stream_finished = False
+        # chaos mode (DESIGN.md §12): when the wrapped engine's config
+        # arms fault injection, the supervisor narrates every fault the
+        # machine absorbs into the RESILIENCE audit trail
+        cfg = getattr(engine, "cfg", None)
+        self._chaos = bool(getattr(cfg, "faults_enabled", False))
+        self._fault_seen: dict[str, int] = {}
 
     # ---- logging --------------------------------------------------------
 
@@ -428,6 +439,31 @@ class RunSupervisor:
                     time.sleep(delay)
                     delay = min(delay * 2, 30.0)
 
+    # ---- chaos mode -----------------------------------------------------
+
+    _CHAOS_KEYS = ("core_failstops", "noc_reroutes", "ecc_corrected",
+                   "ecc_due")
+
+    def _chaos_check(self) -> None:
+        """Log fault-counter movement since the last committed chunk, so
+        the RESILIENCE section records WHEN each injected fault landed."""
+        if not self._chaos:
+            return
+        hc = self.engine.host_counters
+        cur = {
+            k: int(np.asarray(hc[k]).sum())
+            for k in self._CHAOS_KEYS
+            if k in hc
+        }
+        moved = [
+            f"{k} +{v - self._fault_seen.get(k, 0)} (total {v})"
+            for k, v in cur.items()
+            if v > self._fault_seen.get(k, 0)
+        ]
+        if moved:
+            self._log("chaos", "; ".join(moved))
+        self._fault_seen = cur
+
     # ---- guard ----------------------------------------------------------
 
     def _guard_check(self) -> None:
@@ -489,6 +525,19 @@ class RunSupervisor:
         start_steps = self._steps_used()
         self._install_signals()
         self._prev_totals = self._counter_totals()
+        if self._chaos:
+            cfg = self.engine.cfg
+            self._log(
+                "chaos",
+                f"fault injection armed: seed {cfg.fault_seed}, "
+                f"{len(cfg.fault_events)} scheduled event(s), "
+                f"dead policy {cfg.fault_dead_policy}",
+            )
+            self._fault_seen = {
+                k: int(np.asarray(self.engine.host_counters[k]).sum())
+                for k in self._CHAOS_KEYS
+                if k in self.engine.host_counters
+            }
         last_ckpt_t = time.monotonic()
         chunks_since_ckpt = 0
         try:
@@ -502,6 +551,7 @@ class RunSupervisor:
                 chunks_since_ckpt += 1
                 if self.on_chunk is not None:
                     self.on_chunk(self)
+                self._chaos_check()
                 self._guard_check()
                 if self._preempt is not None:
                     signum = self._preempt

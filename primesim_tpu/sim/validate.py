@@ -158,6 +158,14 @@ def check_invariants(cfg: MachineConfig, state, done_mask=None) -> None:
     clocks legitimately go negative once rebases (which track only LIVE
     cores) outrun them — the true clock is `cycles + cycle_base`. Without
     the mask the clock invariant is skipped.
+
+    Fault-aware by construction (DESIGN.md §12): Engine.done_mask() and
+    FleetEngine.core_done_mask() fold fail-stopped cores in, so a chaos
+    run under `--guard=fail` never false-positives on a dead core. The
+    MESI checks need no masking at all — the fail-stop scrub
+    (faults.inject.scrub_dead) removes a dead core from every directory
+    entry, so its stale locally-written L1 state derives to I here,
+    exactly like an invalidated copy.
     """
     def _require(cond, msg):
         if not cond:
@@ -264,8 +272,8 @@ def check_chunk_invariants(
     only make sense at a committed cut.
 
     - clock-window: the slowest LIVE core (not at END, not frozen at a
-      barrier — `live_mask`, see Engine.live_mask) stays within one
-      quantum of `quantum_end`. The golden model asserts this every
+      barrier, not fail-stopped — `live_mask`, see Engine.live_mask)
+      stays within one quantum of `quantum_end`. The golden model asserts this every
       step; here it is the cheap host-side witness that the engine's
       quantum arbitration hasn't drifted.
     - monotone counters: 64-bit host accumulator totals never decrease

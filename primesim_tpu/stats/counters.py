@@ -35,6 +35,11 @@ COUNTER_NAMES = (
     "barrier_waits",   # BARRIER arrivals
     "noc_contention_cycles",  # router-occupancy queueing cycles charged
     "dram_queue_cycles",  # memory-controller queueing waits (dram_queue)
+    # ---- fault injection (DESIGN.md §12; zero with faults disabled) ----
+    "noc_reroutes",    # one-way messages detoured around a dead link
+    "ecc_corrected",   # single-bit flips corrected in-line by SECDED
+    "ecc_due",         # detected-uncorrectable (double-bit) errors
+    "core_failstops",  # cores fail-stopped (scheduled or DUE-escalated)
 )
 
 

@@ -105,6 +105,20 @@ def render_report(
             f"  {_rate(counters['l1_write_hits'][c], l1_writes[c])}"
             f"  {_rate(counters['llc_hits'][c], llc_acc[c])}"
         )
+    fault_keys = ("core_failstops", "noc_reroutes", "ecc_corrected", "ecc_due")
+    fault_total = sum(int(counters[k].sum()) for k in fault_keys if k in counters)
+    if getattr(cfg, "faults_enabled", False) or fault_total:
+        # only rendered when fault injection is configured (or somehow
+        # counted): the faults-off report stays byte-identical to goldens
+        add("")
+        add("FAULTS")
+        add(f"  core fail-stops     {int(counters['core_failstops'].sum()):>16,}")
+        add(f"  NoC reroutes        {int(counters['noc_reroutes'].sum()):>16,}")
+        add(f"  ECC corrected       {int(counters['ecc_corrected'].sum()):>16,}")
+        add(f"  ECC DUE             {int(counters['ecc_due'].sum()):>16,}")
+        dead = np.flatnonzero(counters["core_failstops"])
+        if dead.size:
+            add(f"  dead cores          {', '.join(map(str, dead.tolist()))}")
     if resilience:
         add("")
         add("RESILIENCE")

@@ -1,0 +1,590 @@
+"""Deterministic fault-injection subsystem (DESIGN.md §12).
+
+Covers the whole fault contract: faults-off bit-exactness, seeded
+determinism (same schedule => identical fault counters, solo vs
+fleet-vmapped), dead-core barrier non-deadlock + directory scrub, NoC
+reroute latency accounting against the scalar reference model, SECDED
+ECC corrected/DUE counters, and the chaos-hardened supervisor
+(fault mid-run + preempt + checkpoint + --resume, bit-exact).
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from primesim_tpu.config.machine import (
+    FAULT_CORE_FAILSTOP,
+    FAULT_LINK_DEGRADE,
+    FAULT_LINK_FAIL,
+    FaultConfigError,
+    small_test_config,
+)
+from primesim_tpu.faults.inject import leg_fault_penalty
+from primesim_tpu.faults.prng import (
+    prob_threshold,
+    site_hash,
+    site_hash_np,
+)
+from primesim_tpu.faults.schedule import (
+    FaultSchedule,
+    fault_state_from_config,
+    load_schedule,
+    schedule_from_dict,
+)
+from primesim_tpu.noc import mesh
+from primesim_tpu.sim.engine import Engine
+from primesim_tpu.sim.fleet import FleetEngine, apply_overrides
+from primesim_tpu.sim.supervisor import Preempted, RunSupervisor
+from primesim_tpu.trace import synth
+from primesim_tpu.trace.format import EV_END
+
+FAULT_COUNTERS = ("core_failstops", "noc_reroutes", "ecc_corrected", "ecc_due")
+
+
+def _cfg(**kw):
+    return small_test_config(8, n_banks=4, quantum=200, **kw)
+
+
+def _trace(n_mem_ops=96, seed=3):
+    return synth.uniform_random(
+        8, n_mem_ops=n_mem_ops, shared_frac=0.4, seed=seed
+    )
+
+
+def _armed(cfg=None, **kw):
+    """cfg with faults enabled and the given fault knobs installed."""
+    cfg = cfg or _cfg()
+    kw.setdefault("max_fault_events", max(1, len(kw.get("fault_events", ()))))
+    return dataclasses.replace(cfg, faults_enabled=True, **kw)
+
+
+def _run(cfg, tr, **kw):
+    eng = Engine(cfg, tr, **kw)
+    eng.run()
+    return eng
+
+
+def _same_results(a, b):
+    np.testing.assert_array_equal(a.cycles, b.cycles)
+    bc = b.counters
+    for k, v in a.counters.items():
+        np.testing.assert_array_equal(v, bc[k], err_msg=k)
+
+
+# ---- counter-based PRNG ---------------------------------------------------
+
+
+def test_site_hash_matches_numpy_twin():
+    steps = np.arange(0, 300, 7, dtype=np.int64)
+    sites = np.arange(40, dtype=np.int64)
+    dev = np.asarray(
+        site_hash(
+            jnp.uint32(0xDEADBEEF),
+            jnp.asarray(steps)[:, None],
+            jnp.asarray(sites)[None, :],
+            salt=17,
+        )
+    )
+    host = site_hash_np(0xDEADBEEF, steps[:, None], sites[None, :], salt=17)
+    np.testing.assert_array_equal(dev, host.astype(dev.dtype))
+
+
+def test_site_hash_is_decorrelated_across_inputs():
+    h = np.asarray(
+        site_hash(jnp.uint32(5), jnp.arange(64)[:, None], jnp.arange(8)[None])
+    )
+    assert len(np.unique(h)) == h.size  # no collisions on a small grid
+
+
+def test_prob_threshold_endpoints():
+    assert int(prob_threshold(0.0)) == 0
+    assert int(prob_threshold(1.0)) == 0xFFFFFFFF
+    assert 0 < int(prob_threshold(1e-6)) < int(prob_threshold(1e-3))
+
+
+# ---- faults-off / empty-schedule bit-exactness ----------------------------
+
+
+def test_faults_off_state_has_fault_pytree_but_never_reads_it():
+    eng = _run(_cfg(), _trace())
+    assert int(np.asarray(eng.state.faults.core_dead).sum()) == 0
+    for k in FAULT_COUNTERS:
+        assert int(eng.counters[k].sum()) == 0, k
+
+
+def test_empty_schedule_is_bit_exact_vs_faults_off():
+    tr = _trace()
+    base = _run(_cfg(), tr)
+    armed = _run(_armed(fault_seed=7), tr)
+    _same_results(armed, base)
+    np.testing.assert_array_equal(
+        np.asarray(armed.state.l1), np.asarray(base.state.l1)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(armed.state.dirm), np.asarray(base.state.dirm)
+    )
+
+
+def test_fault_seed_is_traced_not_part_of_jit_key():
+    cfg = _armed(fault_seed=1, fault_flip_l1=1e-6)
+    for seed in (2, 3, 999):
+        ov = apply_overrides(cfg, {"fault_seed": seed})
+        assert ov.fault_seed == seed
+        # identical normalized key => `sweep --vary fault_seed` reuses ONE
+        # compiled program (the no-recompile acceptance criterion)
+        assert ov.timing_normalized() == cfg.timing_normalized()
+
+
+# ---- core fail-stop -------------------------------------------------------
+
+
+def test_failstop_completes_without_deadlock_and_counts_once():
+    tr = _trace()
+    cfg = _armed(fault_events=((5, FAULT_CORE_FAILSTOP, 3, 0),))
+    eng = _run(cfg, tr)
+    assert eng.done()
+    fs = eng.counters["core_failstops"]
+    assert fs[3] == 1 and fs.sum() == 1
+    assert bool(eng.done_mask()[3]) and not bool(eng.live_mask()[3])
+    # the dead core retires nothing after step 5; the others all finish
+    live_done = eng._event_types_at_ptr() == EV_END
+    assert live_done[np.arange(8) != 3].all()
+    eng.verify_invariants()  # directory scrub left a consistent machine
+
+
+def test_failstop_with_barrier_trace_releases_peers():
+    # cores hit barriers every phase; killing one AFTER its first arrival
+    # must not deadlock the quantum loop (dead cores leave the barrier's
+    # quantum accounting)
+    tr = synth.barrier_phases(8, n_phases=3, work_per_phase=8, seed=5)
+    cfg = _armed(fault_events=((2, FAULT_CORE_FAILSTOP, 6, 0),))
+    eng = _run(cfg, tr)
+    assert eng.done()
+    assert eng.counters["core_failstops"].sum() == 1
+    eng.verify_invariants()
+
+
+def test_failstop_dead_policy_writeback_vs_drop():
+    tr = _trace(n_mem_ops=128)
+    ev = ((20, FAULT_CORE_FAILSTOP, 2, 0),)
+    wb = _run(_armed(fault_events=ev, fault_dead_policy="writeback"), tr)
+    dr = _run(_armed(fault_events=ev, fault_dead_policy="drop"), tr)
+    assert wb.done() and dr.done()
+    wb.verify_invariants()
+    dr.verify_invariants()
+    # writeback bills the dead owner for flushing its dirty lines; drop
+    # discards them (no writeback traffic for the dead core's lines)
+    assert (
+        wb.counters["l1_writebacks"].sum() >= dr.counters["l1_writebacks"].sum()
+    )
+
+
+def test_same_schedule_same_seed_is_deterministic():
+    tr = _trace()
+    cfg = _armed(
+        fault_events=((10, FAULT_CORE_FAILSTOP, 1, 0),),
+        fault_flip_l1=1.0,
+        fault_due_rate=0.5,
+        fault_seed=42,
+    )
+    _same_results(_run(cfg, tr), _run(cfg, tr))
+
+
+# ---- link failure / degradation ------------------------------------------
+
+
+# link 0 = tile 0 eastward: the first hop of every tile-0 -> tile-1
+# message on the 2x2 test mesh, so baseline traffic definitely crosses it
+BUSY_LINK = 0
+
+
+def test_link_fail_reroutes_and_adds_latency():
+    tr = _trace(n_mem_ops=128)
+    base = _run(_cfg(), tr)
+    cfg = _armed(fault_events=((0, FAULT_LINK_FAIL, BUSY_LINK, 0),))
+    eng = _run(cfg, tr)
+    assert eng.done()
+    rr = int(eng.counters["noc_reroutes"].sum())
+    assert rr > 0
+    # detours cost hops and cycles in aggregate (per-core deltas are NOT
+    # monotone: slower messages legitimately reorder arbitration races)
+    assert eng.counters["noc_hops"].sum() > base.counters["noc_hops"].sum()
+    assert eng.cycles.sum() > base.cycles.sum()
+    assert eng.cycles.max() >= base.cycles.max()
+
+
+def test_link_degrade_adds_latency_without_reroutes():
+    tr = _trace(n_mem_ops=128)
+    base = _run(_cfg(), tr)
+    cfg = _armed(fault_events=((0, FAULT_LINK_DEGRADE, BUSY_LINK, 9),))
+    eng = _run(cfg, tr)
+    assert eng.done()
+    # degraded links are slower but never detoured
+    assert int(eng.counters["noc_reroutes"].sum()) == 0
+    assert eng.cycles.sum() > base.cycles.sum()
+
+
+def test_leg_penalty_matches_scalar_reference_model():
+    from primesim_tpu.config.machine import NocConfig
+
+    cfg = small_test_config(
+        16, noc=NocConfig(mesh_x=4, mesh_y=4, link_lat=1, router_lat=2)
+    )
+    nl = cfg.n_tiles * 4
+    rng = np.random.default_rng(7)
+    link_dead = (rng.random(nl) < 0.2).astype(np.int32)
+    link_extra = rng.integers(0, 6, nl).astype(np.int32) * (1 - link_dead)
+    fs = fault_state_from_config(
+        dataclasses.replace(cfg, faults_enabled=True, max_fault_events=1)
+    )._replace(
+        link_dead=jnp.asarray(link_dead), link_extra=jnp.asarray(link_extra)
+    )
+    kn = types.SimpleNamespace(
+        link_lat=jnp.int32(cfg.noc.link_lat),
+        router_lat=jnp.int32(cfg.noc.router_lat),
+    )
+    tiles = np.arange(cfg.n_tiles, dtype=np.int32)
+    a = np.repeat(tiles, cfg.n_tiles)
+    b = np.tile(tiles, cfg.n_tiles)
+    lat, hops, rer = leg_fault_penalty(cfg, fs, kn, jnp.asarray(a), jnp.asarray(b))
+    for i in range(a.size):
+        ref = mesh.detour_stats(
+            int(a[i]), int(b[i]), cfg.noc.mesh_x, link_dead, link_extra,
+            cfg.noc.link_lat, cfg.noc.router_lat,
+        )
+        assert (int(lat[i]), int(hops[i]), int(rer[i])) == ref, (a[i], b[i])
+
+
+# ---- ECC (SECDED) ---------------------------------------------------------
+
+
+def test_ecc_corrected_has_counters_but_zero_timing_effect():
+    tr = _trace()
+    base = _run(_cfg(), tr)
+    eng = _run(_armed(fault_flip_l1=1.0, fault_flip_llc=1.0, fault_seed=9), tr)
+    assert int(eng.counters["ecc_corrected"].sum()) > 0
+    assert int(eng.counters["ecc_due"].sum()) == 0
+    # SECDED corrects in-line: counted, never architecturally visible
+    np.testing.assert_array_equal(eng.cycles, base.cycles)
+    for k in ("instructions", "noc_msgs", "llc_misses"):
+        np.testing.assert_array_equal(eng.counters[k], base.counters[k])
+
+
+def test_ecc_due_counted_and_seed_dependent():
+    tr = _trace()
+    cfg = _armed(fault_flip_l1=1.0, fault_due_rate=0.5, fault_seed=1)
+    eng = _run(cfg, tr)
+    due = int(eng.counters["ecc_due"].sum())
+    corr = int(eng.counters["ecc_corrected"].sum())
+    assert due > 0 and corr > 0
+    # without escalation a DUE is counted but not fatal
+    assert int(eng.counters["core_failstops"].sum()) == 0
+    again = _run(cfg, tr)
+    np.testing.assert_array_equal(
+        eng.counters["ecc_due"], again.counters["ecc_due"]
+    )
+
+
+def test_due_failstop_escalation_kills_cores():
+    tr = _trace()
+    cfg = _armed(
+        fault_flip_l1=1.0,
+        fault_due_rate=1.0,
+        fault_due_failstop=True,
+        fault_seed=2,
+    )
+    eng = _run(cfg, tr)
+    assert eng.done()
+    # every core machine-checks on its first (certain) L1 DUE
+    assert int(eng.counters["core_failstops"].sum()) == 8
+
+
+# ---- solo vs fleet determinism -------------------------------------------
+
+
+def test_fault_counters_identical_solo_vs_fleet():
+    # different trace LENGTHS on purpose: the early-finishing element
+    # keeps stepping inside the batch until the fleet drains, and must
+    # accrue NO extra fault counts relative to its solo run
+    tra, trb = _trace(n_mem_ops=48, seed=1), _trace(n_mem_ops=128, seed=2)
+    cfg = _armed(
+        fault_events=((15, FAULT_CORE_FAILSTOP, 4, 0),),
+        fault_flip_l1=1.0,
+        fault_due_rate=0.25,
+    )
+    fleet = FleetEngine(cfg, [tra, trb], [{"fault_seed": 11}, {"fault_seed": 22}])
+    fleet.run()
+    for i, (tr, seed) in enumerate(((tra, 11), (trb, 22))):
+        solo = _run(dataclasses.replace(cfg, fault_seed=seed), tr)
+        np.testing.assert_array_equal(fleet.cycles[i], solo.cycles)
+        for k, v in fleet.counters.items():
+            np.testing.assert_array_equal(
+                v[i], solo.counters[k], err_msg=f"element {i}: {k}"
+            )
+
+
+def test_fleet_fault_seed_sweep_shares_one_jit_key():
+    cfg = _armed(fault_flip_l1=1e-4)
+    fleet = FleetEngine(
+        cfg,
+        [_trace(n_mem_ops=32)] * 3,
+        [{"fault_seed": s} for s in (1, 2, 3)],
+    )
+    keys = {c.timing_normalized() for c in fleet.elem_cfgs}
+    assert keys == {cfg.timing_normalized()}
+
+
+# ---- checkpoint / supervisor (chaos mode) --------------------------------
+
+
+def test_checkpoint_roundtrip_carries_fault_state(tmp_path):
+    tr = _trace(n_mem_ops=128)
+    cfg = _armed(
+        fault_events=((5, FAULT_CORE_FAILSTOP, 0, 0),), fault_flip_l1=1.0
+    )
+    eng = Engine(cfg, tr, chunk_steps=8)
+    eng.run_steps(16)
+    path = str(tmp_path / "ck.npz")
+    eng.save_checkpoint(path)
+    assert int(np.asarray(eng.state.faults.core_dead)[0]) == 1
+    other = Engine(cfg, tr, chunk_steps=8)
+    other.load_checkpoint(path)
+    for k in eng.state.faults._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(other.state.faults, k)),
+            np.asarray(getattr(eng.state.faults, k)),
+            err_msg=k,
+        )
+    eng.run()
+    other.run()
+    _same_results(other, eng)
+
+
+def test_guard_fail_is_fault_aware_no_false_positive():
+    tr = _trace(n_mem_ops=128)
+    cfg = _armed(fault_events=((10, FAULT_CORE_FAILSTOP, 5, 0),))
+    eng = Engine(cfg, tr, chunk_steps=8)
+    sup = RunSupervisor(eng, guard="fail", handle_signals=False)
+    sup.run()  # GuardViolation here would mean dead-core false positive
+    assert eng.done()
+    assert int(eng.counters["core_failstops"].sum()) == 1
+    log = "\n".join(sup.log_lines())
+    assert "chaos" in log and "core_failstops +1" in log
+
+
+def test_chaos_preempt_resume_is_bit_exact(tmp_path):
+    tr = _trace(n_mem_ops=192)
+    cfg = _armed(
+        fault_events=(
+            (6, FAULT_CORE_FAILSTOP, 7, 0),
+            (40, FAULT_LINK_FAIL, 0, 0),
+        ),
+        fault_flip_l1=1.0,
+        fault_due_rate=0.125,
+        fault_seed=5,
+    )
+
+    ref = Engine(cfg, tr, chunk_steps=8)
+    RunSupervisor(ref, guard="fail", handle_signals=False).run()
+    assert ref.done()
+
+    def kill_at(chunk):
+        def on_chunk(sup):
+            if sup.committed == chunk:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        return on_chunk
+
+    eng = Engine(cfg, tr, chunk_steps=8)
+    sup = RunSupervisor(
+        eng,
+        snapshot_dir=str(tmp_path),
+        checkpoint_every_chunks=1,
+        guard="fail",
+        on_chunk=kill_at(2),
+    )
+    with pytest.raises(Preempted):
+        sup.run()
+
+    eng2 = Engine(cfg, tr, chunk_steps=8)
+    sup2 = RunSupervisor(eng2, snapshot_dir=str(tmp_path), guard="fail")
+    assert sup2.resume() is not None
+    sup2.run()
+    assert eng2.done()
+    _same_results(eng2, ref)
+    assert "chaos" in "\n".join(sup2.log_lines())
+
+
+# ---- typed config / schedule errors --------------------------------------
+
+
+def _expect_error(field=None, **cfg_kw):
+    with pytest.raises(FaultConfigError) as ei:
+        _armed(**cfg_kw)
+    if field:
+        assert field in ei.value.location()
+    return ei.value
+
+
+def test_config_validation_rejects_bad_fault_fields():
+    _expect_error(fault_events=((5, FAULT_CORE_FAILSTOP, 99, 0),))  # core oob
+    _expect_error(fault_events=((-2, FAULT_CORE_FAILSTOP, 1, 0),))  # step < 0
+    _expect_error(fault_events=((1, 77, 0, 0),))  # unknown kind
+    _expect_error(fault_events=((1, FAULT_LINK_FAIL, 10_000, 0),))  # link oob
+    _expect_error(fault_flip_l1=1.5)
+    _expect_error(fault_due_rate=-0.1)
+    _expect_error(fault_dead_policy="shrug")
+    _expect_error(  # more events than the static capacity
+        fault_events=((1, FAULT_CORE_FAILSTOP, 0, 0),) * 3, max_fault_events=2
+    )
+
+
+def test_failstop_requires_exact_directory():
+    with pytest.raises(FaultConfigError):
+        dataclasses.replace(
+            small_test_config(64, sharer_group=8),
+            faults_enabled=True,
+            max_fault_events=1,
+            fault_events=((1, FAULT_CORE_FAILSTOP, 0, 0),),
+        )
+
+
+def test_schedule_from_dict_and_typed_errors(tmp_path):
+    sched = schedule_from_dict(
+        {
+            "events": [
+                {"step": 4, "kind": "core_failstop", "core": 2},
+                {"step": 9, "kind": "link_degrade", "link": 1, "extra": 3},
+            ],
+            "flip_l1": 1e-6,
+            "due_failstop": True,
+        }
+    )
+    assert sched.events == (
+        (4, FAULT_CORE_FAILSTOP, 2, 0),
+        (9, FAULT_LINK_DEGRADE, 1, 3),
+    )
+    cfg = sched.apply(_cfg(), seed=3)
+    assert cfg.faults_enabled and cfg.fault_seed == 3
+    assert cfg.max_fault_events == 2  # rounded to a power of two
+    assert cfg.fault_due_failstop
+
+    with pytest.raises(FaultConfigError, match="unknown kind"):
+        schedule_from_dict({"events": [{"step": 1, "kind": "meteor"}]})
+    with pytest.raises(FaultConfigError, match="missing 'step'"):
+        schedule_from_dict({"events": [{"kind": "link_fail", "link": 0}]})
+    with pytest.raises(FaultConfigError, match="unknown schedule field"):
+        schedule_from_dict({"evnets": []})
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(FaultConfigError, match="not valid JSON"):
+        load_schedule(str(bad))
+
+
+def test_schedule_apply_pads_capacity_pow2():
+    s = FaultSchedule(events=((1, FAULT_CORE_FAILSTOP, 0, 0),) * 3)
+    assert s.apply(_cfg()).max_fault_events == 4
+    assert FaultSchedule().apply(_cfg()).max_fault_events == 1
+
+
+# ---- CLI + report surface -------------------------------------------------
+
+
+def _write_cli_inputs(tmp_path, schedule):
+    cfg_path = tmp_path / "m.json"
+    cfg_path.write_text(_cfg().to_json())
+    sc_path = tmp_path / "faults.json"
+    sc_path.write_text(json.dumps(schedule))
+    return str(cfg_path), str(sc_path)
+
+
+def test_cli_run_with_fault_schedule(tmp_path, capsys):
+    from primesim_tpu.cli import main
+
+    cfg_path, sc_path = _write_cli_inputs(
+        tmp_path,
+        {
+            "events": [{"step": 5, "kind": "core_failstop", "core": 3}],
+            "flip_l1": 1.0,
+        },
+    )
+    rpt = str(tmp_path / "r.txt")
+    rc = main(
+        [
+            "run", cfg_path,
+            "--synth", "uniform_random:n_mem_ops=64",
+            "--fault-schedule", sc_path,
+            "--fault-seed", "7",
+            "--report", rpt,
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1])["unit"] == "MIPS"
+    text = open(rpt).read()
+    assert "FAULTS" in text
+    assert "core fail-stops" in text and "dead cores          3" in text
+    assert "ECC corrected" in text
+
+
+def test_cli_fault_seed_requires_armed_config(tmp_path):
+    from primesim_tpu.cli import main
+
+    cfg_path, _ = _write_cli_inputs(tmp_path, {})
+    with pytest.raises(SystemExit, match="fault-seed"):
+        main(
+            [
+                "run", cfg_path,
+                "--synth", "uniform_random:n_mem_ops=16",
+                "--fault-seed", "7",
+            ]
+        )
+
+
+def test_cli_bad_schedule_is_a_clean_error(tmp_path, capsys):
+    from primesim_tpu.cli import main
+
+    cfg_path, sc_path = _write_cli_inputs(
+        tmp_path, {"events": [{"step": 1, "kind": "meteor"}]}
+    )
+    rc = main(
+        [
+            "run", cfg_path,
+            "--synth", "uniform_random:n_mem_ops=16",
+            "--fault-schedule", sc_path,
+        ]
+    )
+    assert rc == 2
+    assert "fault config error" in capsys.readouterr().err
+
+
+def test_cli_faults_reject_streaming_and_golden(tmp_path):
+    from primesim_tpu.cli import main
+
+    cfg_path, sc_path = _write_cli_inputs(
+        tmp_path, {"events": [{"step": 1, "kind": "link_fail", "link": 0}]}
+    )
+    base = [
+        "run", cfg_path, "--synth", "uniform_random:n_mem_ops=16",
+        "--fault-schedule", sc_path,
+    ]
+    with pytest.raises(SystemExit, match="stream"):
+        main(base + ["--stream-window", "64"])
+    with pytest.raises(SystemExit, match="golden"):
+        main(base + ["--engine", "golden"])
+
+
+def test_report_has_no_faults_section_when_off():
+    from primesim_tpu.stats.report import render_report
+
+    eng = _run(_cfg(), _trace(n_mem_ops=32))
+    text = render_report(eng.cfg, eng.counters, eng.cycles)
+    assert "FAULTS" not in text

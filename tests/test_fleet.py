@@ -38,9 +38,18 @@ def assert_element_matches_solo(fleet, i, cfg_eff, trace, chunk_steps):
     for f in es._fields:
         if f == "knobs":
             continue  # knobs are inputs, compared via cfg_eff already
+        a, b = getattr(es, f), getattr(solo.state, f)
+        if hasattr(a, "_fields"):  # nested pytree (faults): leaf-wise
+            for sub in a._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, sub)),
+                    np.asarray(getattr(b, sub)),
+                    err_msg=f"elem {i} state field {f}.{sub}",
+                )
+            continue
         np.testing.assert_array_equal(
-            np.asarray(getattr(es, f)),
-            np.asarray(getattr(solo.state, f)),
+            np.asarray(a),
+            np.asarray(b),
             err_msg=f"elem {i} state field {f}",
         )
 

@@ -40,10 +40,17 @@ def assert_xla_pallas_match(cfg_xla, trace, chunk_steps=16):
     for f in ex.state._fields:
         if f == "knobs":
             continue  # inputs, identical by construction
+        a, b = getattr(ex.state, f), getattr(ep.state, f)
+        if hasattr(a, "_fields"):  # nested pytree (faults): leaf-wise
+            for sub in a._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, sub)),
+                    np.asarray(getattr(b, sub)),
+                    err_msg=f"state field {f}.{sub}",
+                )
+            continue
         np.testing.assert_array_equal(
-            np.asarray(getattr(ex.state, f)),
-            np.asarray(getattr(ep.state, f)),
-            err_msg=f"state field {f}",
+            np.asarray(a), np.asarray(b), err_msg=f"state field {f}"
         )
 
 
@@ -171,9 +178,18 @@ def test_fleet_vmapped_pallas_step():
         for f in es._fields:
             if f == "knobs":
                 continue
+            a, b = getattr(es, f), getattr(solo.state, f)
+            if hasattr(a, "_fields"):  # nested pytree (faults)
+                for sub in a._fields:
+                    np.testing.assert_array_equal(
+                        np.asarray(getattr(a, sub)),
+                        np.asarray(getattr(b, sub)),
+                        err_msg=f"elem {i} state field {f}.{sub}",
+                    )
+                continue
             np.testing.assert_array_equal(
-                np.asarray(getattr(es, f)),
-                np.asarray(getattr(solo.state, f)),
+                np.asarray(a),
+                np.asarray(b),
                 err_msg=f"elem {i} state field {f}",
             )
 
