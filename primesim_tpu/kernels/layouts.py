@@ -100,6 +100,22 @@ def first_min(vals):
     return jnp.min(jnp.where(vals == m, iota, W), axis=1, keepdims=True)
 
 
+def cummax_rows(vals):
+    """jax.lax.cummax(axis=1) semantics over a [BC, W] int32: inclusive
+    running max along the lane dim as a static unroll of masked reduces
+    (one masked max + one-hot select per output column — Mosaic has no
+    lane-dim scan or shift). Bit-exact vs lax.cummax: integer max is
+    associative, so the per-column reduce IS the prefix."""
+    W = vals.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    NEG = jnp.iinfo(jnp.int32).min
+    out = jnp.zeros_like(vals)
+    for k in range(W):
+        m = jnp.max(jnp.where(iota <= k, vals, NEG), axis=1, keepdims=True)
+        out = jnp.where(iota == k, m, out)
+    return out
+
+
 def popcount(x):
     """Per-element bit count of nonneg int32 words, shift/mask form (no
     multiply that could wrap; matches lax.population_count exactly)."""

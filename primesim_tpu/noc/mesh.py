@@ -97,6 +97,19 @@ def path_links(cfg: MachineConfig, a, b):
     )
 
 
+def concat_legs(legs):
+    """Concatenate per-leg XY paths and their lane masks into the
+    contention models' [C, legs·H] layout: ``legs`` is a sequence of
+    (path_links result [C, H], lane mask [C]) pairs.  Both the "link"
+    occupancy count and the hop-by-hop router block run every per-link
+    operation ONCE over this concatenation (one scatter, one rank, one
+    gather pair) — per-kernel overhead is the budget, so per-path loops
+    become per-path kernels (sim/engine.py)."""
+    pths = [p for p, _ in legs]
+    masks = [jnp.broadcast_to(m[:, None], p.shape) for p, m in legs]
+    return jnp.concatenate(pths, axis=1), jnp.concatenate(masks, axis=1)
+
+
 # ---- fault-model detour (DESIGN.md §12) -----------------------------------
 # A FAILED directed link on a message's XY path forces an adaptive
 # fallback around it: one orthogonal sidestep and return, i.e. +2 hops and

@@ -1,0 +1,131 @@
+"""Sort-based segmented FIFO ranking — the shared rank primitive of the
+router and DRAM-queue contention models (DESIGN.md §13).
+
+Both models need, per same-step transaction i and per FIFO segment s it
+enters (a directed NoC link, or a DRAM bank controller),
+
+    rank[i, s] = #{ j : key[j] < key[i],  lane j enters segment s }
+
+— the number of packets ahead of lane i in s's same-step FIFO, ordered
+by the phase-2 arbitration key.  The engine historically produced this
+as an int8 one-hot matmul: a [C, C] `kless` comparison matrix contracted
+against a [C, n_seg] membership one-hot — O(C² · n_seg) int-MACs
+(~4×10⁹ per step at C=1024, n_seg≈4096).  `segmented_rank` computes the
+identical int32 counts in O(E log E) over the E = C·S flattened
+(segment, key) entries: one sort, one binary-search gather, one
+segment-start histogram.
+
+EXACT-EQUIVALENCE ARGUMENT (why the counts are integer-equal to the
+matmul's, including duplicate keys):
+
+1. `lane_order` maps each lane's key to its dense first-occurrence rank
+   ``ord[i] = #{j : key[j] < key[i]}``.  ord is monotone in key and
+   collapses ties, so ``key[j] < key[i]  ⟺  ord[j] < ord[i]``.
+2. Each entry packs to ``seg·C + ord`` (strictly ordered by (seg, ord));
+   after one flat sort, ``searchsorted(side="left")`` returns the count
+   of entries with a strictly smaller packed value — all entries of
+   earlier segments plus same-segment entries with strictly smaller ord.
+   Equal keys share one packed value, so tied lanes never count each
+   other, exactly like the matmul's strict `<`.
+3. Subtracting the segment's start offset (an exclusive cumsum of the
+   per-segment histogram = the count of entries in earlier segments)
+   leaves the same-segment strictly-smaller count: the matmul rank.
+
+CONTRACT: one entry per (lane, segment) — a lane may not enter the same
+segment's FIFO twice in one step, or the sort counts it twice while the
+matmul's one-hot `.set(1)` collapses it.  The engine guarantees this by
+construction: request and reply legs traverse *reversed directed* links
+(distinct ids), and the barrier-arrival leg is masked to barrier lanes,
+disjoint from home-transaction lanes.  Masked entries use ``seg ==
+n_seg`` (one past the last real segment); their ranks are garbage the
+caller must mask, same as the matmul path's out-of-range gathers.
+
+Everything here is plain int32 sort/scan/scatter — vmap-safe, so the
+fleet engine batches it unchanged, and the jit key stays geometry-only
+(keys/segments are traced data).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def lane_order(key):
+    """Dense first-occurrence rank of each lane's arbitration key:
+    ``ord[i] = #{j : key[j] < key[i]}`` — [C] int32 in [0, C).
+
+    Monotone in key with ties collapsed, so strict key comparisons and
+    strict ord comparisons agree; computed with one C-element sort plus
+    a group-start cummax (duplicates inherit their group's start)."""
+    C = key.shape[0]
+    pos = jnp.arange(C, dtype=jnp.int32)
+    sk, sl = jax.lax.sort((key.astype(jnp.int32), pos), num_keys=1)
+    grp_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sk[1:] != sk[:-1]]
+    )
+    gstart = jax.lax.cummax(jnp.where(grp_start, pos, 0))
+    return jnp.zeros((C,), jnp.int32).at[sl].set(gstart)
+
+
+def _segment_starts(seg_flat, n_seg: int):
+    """Exclusive per-segment start offsets: start[s] = # entries with
+    segment id < s, via histogram + exclusive cumsum ([n_seg + 1])."""
+    h = jnp.zeros((n_seg + 1,), jnp.int32).at[seg_flat].add(1, mode="drop")
+    return jnp.cumsum(h) - h
+
+
+def segmented_rank(seg, key=None, n_seg=None, *, order=None, method="auto"):
+    """Same-step FIFO ranks, integer-equal to the one-hot-matmul path.
+
+    seg    [C, S] int32 — segment id per (lane, slot), in [0, n_seg];
+           ``n_seg`` is the masked sentinel (ranks at masked slots are
+           unspecified, mask them downstream).
+    key    [C] int32 — per-lane arbitration key (any dtype ordering);
+           ignored when a precomputed ``order=lane_order(key)`` is given
+           (share one lane_order across the router and DRAM blocks).
+    n_seg  static int — number of real segments.
+
+    Returns [C, S] int32: rank[i, s] = # of (lane j ≠ i, slot) entries
+    with seg == seg[i, s] and key[j] strictly < key[i], counting each
+    such lane once (contract: entries unique per (lane, segment)).
+
+    method="packed" sorts ``seg·C + ord`` as ONE int32 key (requires
+    (n_seg + 1)·C ≤ int32 max — true for every shipped geometry);
+    "lex" is the general two-key lexicographic sort; "auto" picks.
+    """
+    if n_seg is None:
+        raise TypeError("segmented_rank: n_seg is required")
+    C, S = seg.shape
+    if order is None:
+        order = lane_order(key)
+    seg = seg.astype(jnp.int32)
+    seg_flat = seg.reshape(C * S)
+    if method == "auto":
+        method = "packed" if (n_seg + 1) * C <= int(INT32_MAX) else "lex"
+    if method == "packed":
+        packed = (seg * jnp.int32(C) + order[:, None]).reshape(C * S)
+        sp = jax.lax.sort(packed)
+        first = jnp.searchsorted(sp, packed, side="left").astype(jnp.int32)
+        start = _segment_starts(seg_flat, n_seg)
+        return (
+            first - start[jnp.clip(seg_flat, 0, n_seg)]
+        ).reshape(C, S)
+    if method == "lex":
+        E = C * S
+        pos = jnp.arange(E, dtype=jnp.int32)
+        ord_flat = jnp.broadcast_to(order[:, None], (C, S)).reshape(E)
+        sseg, sord, sidx = jax.lax.sort(
+            (seg_flat, ord_flat, pos), num_keys=2
+        )
+        one = jnp.ones((1,), jnp.bool_)
+        seg_start = jnp.concatenate([one, sseg[1:] != sseg[:-1]])
+        grp_start = seg_start | jnp.concatenate([one, sord[1:] != sord[:-1]])
+        seg0 = jax.lax.cummax(jnp.where(seg_start, pos, 0))
+        grp0 = jax.lax.cummax(jnp.where(grp_start, pos, 0))
+        return jnp.zeros((E,), jnp.int32).at[sidx].set(grp0 - seg0).reshape(
+            C, S
+        )
+    raise ValueError(f"segmented_rank: unknown method {method!r}")

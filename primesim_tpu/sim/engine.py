@@ -109,7 +109,14 @@ def _one_way(tile_a, tile_b, cfg: MachineConfig, kn):
 # vectorized XY route builder (link id = tile*4 + dir, dir 0=E 1=W 2=N
 # 3=S), shared with the fault-injection detour model — lives in noc.mesh
 # next to its scalar reference `xy_links`
+from ..noc.mesh import concat_legs as _concat_legs  # noqa: E402
 from ..noc.mesh import path_links as _path_links  # noqa: E402
+
+# sort-based segmented FIFO ranking (DESIGN.md §13) — the shared rank
+# primitive of the router and DRAM-queue contention models; replaces the
+# historical O(C²·n_seg) one-hot matmuls with one O(E log E) sort,
+# integer-equal by construction
+from ..ops.ranking import lane_order, segmented_rank  # noqa: E402
 
 
 def _l1_probe(cfg: MachineConfig, arange_c, l1, dirm, line,
@@ -744,14 +751,16 @@ def step(
             req_p = _path_links(cfg, ctile, btile)  # [C, H]
             rep_p = _path_links(cfg, btile, ctile)
             arr_p = _path_links(cfg, ctile, htile)
-            lcnt = jnp.zeros(NL, jnp.int32)
-            for pth, mask in (
-                (req_p, home_txn),
-                (rep_p, home_txn),
-            ) + (((arr_p, is_barrier),) if has_sync else ()):
-                lcnt = lcnt.at[
-                    jnp.where(mask[:, None] & (pth >= 0), pth, NL)
-                ].add(1, mode="drop")
+            # every leg's occupancy in ONE concatenated [C, legs*H]
+            # scatter-add (the router block's idiom; integer adds are
+            # order-independent, so folding the per-path loop is exact)
+            lpth, lmask = _concat_legs(
+                [(req_p, home_txn), (rep_p, home_txn)]
+                + ([(arr_p, is_barrier)] if has_sync else [])
+            )
+            lcnt = jnp.zeros(NL, jnp.int32).at[
+                jnp.where(lmask & (lpth >= 0), lpth, NL)
+            ].add(1, mode="drop")
 
             def _path_worst(pth):
                 cts = lcnt[jnp.where(pth >= 0, pth, 0)]
@@ -993,8 +1002,11 @@ def step(
     # Miss winners queue at their home bank's controller: wait floor =
     # max(dram_free[bank], bank's earliest nominal arrival this step) +
     # rank*service — the router model's FIFO shape on a per-bank clock.
-    # Ranks via the same int8 one-hot matmul; bit-exact vs golden
-    # (tests/test_dram.py).
+    # Ranks via the shared sort-based segmented-rank primitive (one dense
+    # key order feeds this block AND the router walk); bit-exact vs
+    # golden (tests/test_dram.py).
+    if cfg.dram_queue or router:
+        ord_c = lane_order(key)
     if cfg.dram_queue:
         svc_d = jnp.where(kn.dram_service > 0, kn.dram_service, kn.dram_lat)
         a_nom = (
@@ -1005,20 +1017,10 @@ def step(
         dbase = jnp.full(B, INT32_MAX, jnp.int32).at[dtgt].min(
             a_nom, mode="drop"
         )
-        kd = ((key[None, :] < key[:, None]) & llc_miss[None, :]).astype(
-            jnp.int8
-        )
-        Ud = jnp.zeros((C, B), jnp.int8).at[arange_c, dtgt].set(
-            1, mode="drop"
-        )
-        rd = jnp.take_along_axis(
-            jax.lax.dot_general(
-                kd, Ud, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            ),
-            bank[:, None],
-            axis=1,
-        )[:, 0]
+        # non-miss lanes carry the sentinel segment: their rd is garbage
+        # the where/drop masks below never let escape (same tolerance the
+        # matmul path's full-table gather relied on)
+        rd = segmented_rank(dtgt[:, None], n_seg=B, order=ord_c)[:, 0]
         dstart = jnp.maximum(
             a_nom,
             jnp.maximum(st.dram_free[bank], dbase[bank]) + rd * svc_d,
@@ -1060,9 +1062,11 @@ def step(
         #   t_k = max(t0 + router_lat, cummax_{k'<=k}(F_k' - k'c)) + kc
         # so one cummax per path replaces the sequential walk, and the
         # per-link departures feed one scatter-max into link_free. Ranks
-        # come from an int8 one-hot matmul on the MXU (exact int32
-        # counts). Bit-exact vs the golden scalar walk (tests/
-        # test_router.py).
+        # come from the shared sort-based segmented-rank primitive
+        # (ops/ranking.py, DESIGN.md §13): O(E log E) over the flattened
+        # (link, key) entries instead of the historical O(C²·NL) one-hot
+        # matmul, integer-equal by construction. Bit-exact vs the golden
+        # scalar walk (tests/test_router.py).
         from ..noc.mesh import n_links
 
         NL = n_links(cfg)
@@ -1083,12 +1087,6 @@ def step(
             + jnp.where(pre_chg, epre * cpi_vec, 0)
             + jnp.where(mem_lane, l1_lat, 0)
         )
-        # canonical same-step order: the phase-2 arbitration key
-        txn = home_txn | is_barrier
-        kless = (
-            (key[None, :] < key[:, None]) & txn[None, :]
-        ).astype(jnp.int8)
-        U = jnp.zeros((C, NL), jnp.int8)
         # nominal (uncontended) arrival at each hop; reply legs anchor
         # at llc.latency service by definition (golden _bump)
         a_req = t0[:, None] + R_lat + hidx * c_hop
@@ -1101,70 +1099,77 @@ def step(
             + hidx * c_hop
         )
         # EVERY per-link operation runs once over the concatenated paths
-        # ([C, 2H] legs, or [C, 3H] with the barrier-arrival leg): one U
-        # scatter, one base scatter-min, one rank take_along, one
-        # link_free/base gather pair — per-kernel overhead is the budget,
-        # so per-path loops are per-path kernels
-        pth_all = jnp.concatenate(
-            [req_p, rep_p] + ([arr_p] if has_sync else []), axis=1
-        )
-        mask_all = jnp.concatenate(
-            [
-                jnp.broadcast_to(home_txn[:, None], req_p.shape),
-                jnp.broadcast_to(home_txn[:, None], rep_p.shape),
-            ]
-            + (
-                [jnp.broadcast_to(is_barrier[:, None], arr_p.shape)]
-                if has_sync
-                else []
-            ),
-            axis=1,
+        # ([C, 2H] legs, or [C, 3H] with the barrier-arrival leg): one
+        # segmented rank, one base scatter-min, one link_free/base gather
+        # pair — per-kernel overhead is the budget, so per-path loops are
+        # per-path kernels. The per-(lane, segment) uniqueness contract
+        # of segmented_rank holds by construction: request and reply
+        # legs traverse reversed DIRECTED links (distinct ids), and the
+        # barrier-arrival leg is masked to barrier lanes, disjoint from
+        # home-transaction lanes.
+        pth_all, mask_all = _concat_legs(
+            [(req_p, home_txn), (rep_p, home_txn)]
+            + ([(arr_p, is_barrier)] if has_sync else [])
         )
         a_all = jnp.concatenate(
             [a_req, a_rep] + ([a_req] if has_sync else []), axis=1
         )
         ok_all = mask_all & (pth_all >= 0)
         tgt_all = jnp.where(ok_all, pth_all, NL)
-        U = U.at[arange_c[:, None], tgt_all].set(1, mode="drop")
         base = jnp.full(NL, INT32_MAX, jnp.int32).at[tgt_all].min(
             a_all, mode="drop"
         )
-        ranks = jax.lax.dot_general(
-            kless, U, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # [C, NL]: packets ahead of lane i in l's same-step FIFO
+        # packets ahead of lane i in each hop's same-step FIFO, ordered
+        # by the phase-2 arbitration key (masked slots carry garbage the
+        # SENT select below discards, as the matmul table gather did)
+        r_all = segmented_rank(tgt_all, n_seg=NL, order=ord_c)
         pc_all = jnp.where(pth_all >= 0, pth_all, 0)
-        r_all = jnp.take_along_axis(ranks, pc_all, axis=1)
-        F_all = jnp.where(
-            ok_all,
-            jnp.maximum(st.link_free[pc_all], base[pc_all]) + r_all * L_lat,
-            SENT,
-        )  # [C, legs*H] wait floors, one gather pair for every leg
-
-        def _cascade(t_start, F, nh):
-            G = F - hidx * c_hop
-            cum = jax.lax.cummax(G, axis=1)
-            t1 = t_start + R_lat
-            t_end = jnp.maximum(t1, cum[:, -1]) + nh * c_hop
-            departs = jnp.maximum(t1[:, None], cum) + hidx * c_hop + L_lat
-            return t_end, departs
-
+        lf_g = st.link_free[pc_all]  # [C, legs*H] per-hop gather pair —
+        bs_g = base[pc_all]  # data-dependent rows, staged in XLA (§13)
         arr_lat_a, arr_hops = _one_way(ctile, htile, cfg, kn)
-        t_req_end, d_req = _cascade(t0, F_all[:, :H], req_hops)
-        t_rep_end, d_rep = _cascade(
-            t_req_end + service, F_all[:, H : 2 * H], rep_hops
-        )
+        if pallas_step:
+            # [PALLAS] wait floors + per-leg cummax cascades + departure
+            # composition fused in one VMEM kernel (router_kernels.py);
+            # the link_free/base row gathers above and the departure
+            # scatter-max below stay XLA — the one access shape the
+            # block model cannot express (same boundary as the commit
+            # kernel's dirm row scatter)
+            from ..kernels.router_kernels import router_cascade
+
+            t_rep_end, t_arr_end, d_all = router_cascade(
+                lf_g, bs_g, r_all, ok_all, t0, service, req_hops,
+                rep_hops, arr_hops, L_lat, R_lat, has_sync=has_sync,
+            )
+        else:
+            F_all = jnp.where(
+                ok_all, jnp.maximum(lf_g, bs_g) + r_all * L_lat, SENT
+            )  # [C, legs*H] wait floors
+
+            def _cascade(t_start, F, nh):
+                G = F - hidx * c_hop
+                cum = jax.lax.cummax(G, axis=1)
+                t1 = t_start + R_lat
+                t_end = jnp.maximum(t1, cum[:, -1]) + nh * c_hop
+                departs = (
+                    jnp.maximum(t1[:, None], cum) + hidx * c_hop + L_lat
+                )
+                return t_end, departs
+
+            t_req_end, d_req = _cascade(t0, F_all[:, :H], req_hops)
+            t_rep_end, d_rep = _cascade(
+                t_req_end + service, F_all[:, H : 2 * H], rep_hops
+            )
+            deps = [d_req, d_rep]
+            if has_sync:
+                t_arr_end, d_arr = _cascade(t0, F_all[:, 2 * H :], arr_hops)
+                deps.append(d_arr)
+            d_all = jnp.concatenate(deps, axis=1)
         raw_rt = t_rep_end - t0  # valid on home_txn lanes
         extra_home = raw_rt - (req_lat + service + rep_lat)
-        deps = [d_req, d_rep]
         if has_sync:
-            t_arr_end, d_arr = _cascade(t0, F_all[:, 2 * H :], arr_hops)
             raw_arr = t_arr_end - t0  # valid on barrier lanes
             extra_bar = raw_arr - arr_lat_a
-            deps.append(d_arr)
-        link_free_n = st.link_free.at[tgt_all].max(
-            jnp.concatenate(deps, axis=1), mode="drop"
-        )
+        link_free_n = st.link_free.at[tgt_all].max(d_all, mode="drop")
         cnt = cadd(
             cnt,
             "noc_contention_cycles",

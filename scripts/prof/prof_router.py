@@ -1,0 +1,198 @@
+"""Where do the rung-3 ms go? Phase cuts for the FIFO-contention path.
+
+Self-contained component timings at the SHIPPED rung-3 shapes
+(`configs/rung3_1024core_o3.json`: 1024 cores, 32x32 mesh -> H=62 hop
+columns, 4096 directed links, 1024 DRAM banks) isolating the three
+costs of the router + DRAM-queue step tail (DESIGN.md §13):
+
+- `rank`: the same-step FIFO rank primitive — the shipped sort-based
+  `ops.ranking.segmented_rank` (O(E log E)) vs the retired one-hot
+  matmul formulation ([C,C] int8 kless x [C,NL] one-hot, O(C^2 * NL)
+  MACs) it replaced, at identical shapes. This is the cut that moved
+  rung 3 from ~1296 to ~67 ms/step on a 1-core CPU container.
+- `cascade`: the wait-floor + per-leg cummax cascade + departures, XLA
+  closed form vs the fused Pallas kernel (`kernels.router_kernels`,
+  interpreter mode off-TPU — so on CPU this row measures the interpreter,
+  not Mosaic; compare on TPU for the real kernel number).
+- `scatter`: the data-dependent edges that stay XLA on purpose — the
+  base scatter-min, the per-hop link_free/base gather pair, and the
+  departure scatter-max back into link_free.
+
+Plus whole-step ms/step on the full rung-3 machine for both
+`step_impl=xla` and `=pallas` (the end-to-end number the components
+should sum toward). No source surgery — everything here calls shipped
+entry points, so this tool cannot rot silently.
+
+Usage: `python scripts/prof/prof_router.py` · env:
+`PRIMETPU_PROF_MATMUL=0` skips the retired-matmul reference row (it is
+deliberately the slow one), `PRIMETPU_PROF_STEPS` (default 16) sizes
+the whole-step chunks.
+"""
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from primesim_tpu.config.machine import MachineConfig
+from primesim_tpu.kernels.router_kernels import SENT, router_cascade
+from primesim_tpu.ops.ranking import lane_order, segmented_rank
+from primesim_tpu.sim.engine import run_chunk
+from primesim_tpu.sim.state import init_state
+from primesim_tpu.trace import synth
+from primesim_tpu.trace.format import fold_ins
+
+R3 = os.path.join(os.path.dirname(__file__), "..", "..", "configs",
+                  "rung3_1024core_o3.json")
+
+
+def timed(fn, *args, runs=3, tag=""):
+    """jit + compile warm-up + best-of-N; host-transfer sync (np.asarray
+    of a leaf — the round-3 under-sync lesson, see prof_step.py)."""
+    f = jax.jit(fn)
+    out = f(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+    walls = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = f(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        walls.append(time.perf_counter() - t0)
+    ms = min(walls) * 1e3
+    print(f"[{tag}] {ms:.3f} ms", flush=True)
+    return ms
+
+
+def router_shapes(cfg, seed=0):
+    """Random operands at the engine's router-block shapes: per-lane
+    FIFO keys, per-(lane,slot) link targets (within-lane distinct, the
+    contract segmented_rank assumes), wait floors, masks."""
+    rng = np.random.default_rng(seed)
+    C = cfg.n_cores
+    NL = cfg.n_tiles * 4
+    H = max(1, (cfg.noc.mesh_x - 1) + (cfg.noc.mesh_y - 1))
+    LT = 3 * H  # req + rep + barrier-arrival legs
+    key = jnp.asarray(
+        rng.integers(0, 1 << 20, C).astype(np.int32) * C
+        + np.arange(C, dtype=np.int32)
+    )
+    base_l = rng.integers(0, NL - LT, (C, 1)).astype(np.int32)
+    tgt = jnp.asarray(base_l + np.arange(LT, dtype=np.int32)[None, :])
+    ok = jnp.asarray(rng.random((C, LT)) < 0.7)
+    tgt = jnp.where(ok, tgt, NL)
+    lf = jnp.asarray(rng.integers(0, 1000, (C, LT)).astype(np.int32))
+    bs = jnp.asarray(rng.integers(0, 1000, (C, LT)).astype(np.int32))
+    t0 = jnp.asarray(rng.integers(0, 500, C).astype(np.int32))
+    sv = jnp.asarray(rng.integers(1, 80, C).astype(np.int32))
+    nh = jnp.asarray(rng.integers(0, H + 1, (3, C)).astype(np.int32))
+    return dict(C=C, NL=NL, H=H, LT=LT, key=key, tgt=tgt, ok=ok,
+                lf=lf, bs=bs, t0=t0, sv=sv, nh=nh)
+
+
+def rank_cuts(s):
+    def sort_rank(key, tgt):
+        return segmented_rank(tgt, n_seg=s["NL"], order=lane_order(key))
+
+    def matmul_rank(key, tgt):
+        # the retired formulation: strict-less MXU product against the
+        # per-slot one-hot competitor matrix, then per-slot gather
+        kless = (key[None, :] < key[:, None]).astype(jnp.int8)
+        seg = jnp.clip(tgt, 0, s["NL"] - 1)
+        U = jnp.zeros((s["C"], s["NL"]), jnp.int8)
+        U = U.at[jnp.arange(s["C"])[:, None], seg].set(1)
+        full = jax.lax.dot_general(
+            kless, U, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return jnp.take_along_axis(full, seg, axis=1)
+
+    timed(sort_rank, s["key"], s["tgt"], tag="rank: sort segmented_rank")
+    if os.environ.get("PRIMETPU_PROF_MATMUL", "1") != "0":
+        timed(matmul_rank, s["key"], s["tgt"],
+              tag="rank: retired one-hot matmul")
+
+
+def cascade_cuts(s, cfg):
+    H, LT = s["H"], s["LT"]
+    L_lat = jnp.int32(cfg.noc.link_lat)
+    R_lat = jnp.int32(cfg.noc.router_lat)
+    r = segmented_rank(s["tgt"], n_seg=s["NL"], order=lane_order(s["key"]))
+
+    def xla_cascade(lf, bs, r, ok, t0, sv, nh):
+        c_hop = L_lat + R_lat
+        hidx = jnp.arange(H, dtype=jnp.int32)[None, :]
+        F = jnp.where(ok, jnp.maximum(lf, bs) + r * L_lat, SENT)
+
+        def leg(t_start, Fl, n):
+            G = Fl - hidx * c_hop
+            cum = jax.lax.cummax(G, axis=1)
+            t1 = t_start + R_lat
+            t_end = jnp.maximum(t1, cum[:, -1]) + n * c_hop
+            return t_end, jnp.maximum(t1[:, None], cum) + hidx * c_hop + L_lat
+
+        te_req, d_req = leg(t0, F[:, :H], nh[0])
+        te_rep, d_rep = leg(te_req + sv, F[:, H:2 * H], nh[1])
+        te_arr, d_arr = leg(t0, F[:, 2 * H:], nh[2])
+        return te_rep, te_arr, jnp.concatenate([d_req, d_rep, d_arr], axis=1)
+
+    def pallas_cascade(lf, bs, r, ok, t0, sv, nh):
+        return router_cascade(lf, bs, r, ok, t0, sv, nh[0], nh[1], nh[2],
+                              L_lat, R_lat, has_sync=True)
+
+    a = (s["lf"], s["bs"], r, s["ok"], s["t0"], s["sv"], s["nh"])
+    timed(xla_cascade, *a, tag="cascade: xla closed form")
+    kind = "mosaic" if jax.default_backend() == "tpu" else "interpreter"
+    timed(pallas_cascade, *a, tag=f"cascade: pallas kernel ({kind})")
+
+
+def scatter_cuts(s):
+    NL, LT = s["NL"], s["LT"]
+    link_free = jnp.zeros(NL, jnp.int32)
+    d_all = s["lf"] + 7
+
+    def base_min_gather(key, tgt, ok):
+        key_s = jnp.where(ok, key[:, None], jnp.int32((1 << 31) - 1))
+        base = jnp.full(NL + 1, (1 << 31) - 1, jnp.int32)
+        base = base.at[tgt].min(key_s, mode="drop")[:NL]
+        pc = jnp.clip(tgt, 0, NL - 1)
+        return link_free[pc], base[pc]
+
+    def depart_max(tgt, d):
+        return link_free.at[tgt].max(d, mode="drop")
+
+    timed(base_min_gather, s["key"], s["tgt"], s["ok"],
+          tag="scatter: base min + per-hop gather pair")
+    timed(depart_max, s["tgt"], d_all, tag="scatter: departure max")
+
+
+def whole_step(cfg, step_impl, n_steps):
+    cfg = (cfg if cfg.step_impl == step_impl
+           else __import__("dataclasses").replace(cfg, step_impl=step_impl))
+    trace = fold_ins(synth.fft_like(
+        cfg.n_cores, n_phases=2, points_per_core=16, ins_per_mem=8, seed=42))
+    events = jnp.asarray(trace.line_events(cfg.line_bits))
+    st = init_state(cfg)
+    st = run_chunk(cfg, n_steps, events, st, has_sync=True)
+    np.asarray(st.step)
+    t0 = time.perf_counter()
+    for _ in range(2):
+        st = run_chunk(cfg, n_steps, events, st, has_sync=True)
+    np.asarray(st.step)
+    ms = (time.perf_counter() - t0) / 2 / n_steps * 1e3
+    print(f"[whole rung-3 step: {step_impl}] {ms:.3f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), flush=True)
+    with open(R3) as f:
+        cfg = MachineConfig.from_json(f.read())
+    s = router_shapes(cfg)
+    print(f"shapes: C={s['C']} NL={s['NL']} H={s['H']} legs*H={s['LT']}")
+    rank_cuts(s)
+    cascade_cuts(s, cfg)
+    scatter_cuts(s)
+    n = int(os.environ.get("PRIMETPU_PROF_STEPS", "16"))
+    whole_step(cfg, "xla", n)
+    whole_step(cfg, "pallas", n)

@@ -1,0 +1,198 @@
+"""Property tests for the sort-based segmented-rank primitive
+(ops/ranking.py, ISSUE 6 tentpole): on every input shape the engine can
+produce — duplicate arbitration keys, masked lanes/slots, real mesh XY
+paths (including faulted-config geometries, whose ranking walk stays on
+the NOMINAL path by design), and fleet-vmapped batches — the sort path
+must return the EXACT int32 counts of the historical one-hot-matmul
+path it replaced (DESIGN.md §13 equivalence argument)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from primesim_tpu.config.machine import (
+    FAULT_LINK_FAIL,
+    NocConfig,
+    small_test_config,
+)
+from primesim_tpu.noc.mesh import n_links, path_links
+from primesim_tpu.ops.ranking import lane_order, segmented_rank
+
+
+def matmul_oracle(seg, key, n_seg, competitor=None):
+    """The replaced path, reference-shaped: [C,C] strict-less comparison
+    contracted against the [C,n_seg] one-hot membership (duplicates in a
+    lane's row collapse via `set(1)`), gathered back per slot."""
+    seg = np.asarray(seg)
+    key = np.asarray(key)
+    C, S = seg.shape
+    comp = np.ones(C, bool) if competitor is None else np.asarray(competitor)
+    kless = (key[None, :] < key[:, None]) & comp[None, :]
+    U = np.zeros((C, n_seg + 1), np.int32)
+    U[np.arange(C)[:, None], np.clip(seg, 0, n_seg)] = 1
+    ranks = kless.astype(np.int32) @ U  # [C, n_seg + 1]
+    out = np.take_along_axis(ranks, np.clip(seg, 0, n_seg), axis=1)
+    return out  # valid wherever seg < n_seg
+
+
+def _unique_segs(rng, C, S, n_seg, mask_p=0.4):
+    """Per-lane DISTINCT segment ids (the engine contract: one entry per
+    (lane, segment)), with a random fraction masked to the sentinel."""
+    seg = np.stack(
+        [rng.choice(n_seg, size=S, replace=False) for _ in range(C)]
+    ).astype(np.int32)
+    return np.where(rng.random((C, S)) < mask_p, n_seg, seg).astype(np.int32)
+
+
+@pytest.mark.parametrize("method", ["packed", "lex"])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_matches_oracle(method, seed):
+    rng = np.random.default_rng(seed)
+    C, S, n_seg = 64, 9, 37
+    seg = _unique_segs(rng, C, S, n_seg)
+    key = rng.integers(0, 500, C).astype(np.int32)  # dense => duplicates
+    got = np.asarray(
+        segmented_rank(jnp.asarray(seg), jnp.asarray(key), n_seg,
+                       method=method)
+    )
+    want = matmul_oracle(seg, key, n_seg)
+    valid = seg < n_seg
+    np.testing.assert_array_equal(got[valid], want[valid])
+
+
+def test_duplicate_keys_never_count_each_other():
+    # every lane shares ONE key: all ranks must be zero (strict <)
+    C, S, n_seg = 16, 4, 8
+    rng = np.random.default_rng(7)
+    seg = _unique_segs(rng, C, S, n_seg, mask_p=0.0)
+    key = np.full(C, 42, np.int32)
+    got = np.asarray(segmented_rank(jnp.asarray(seg), jnp.asarray(key), n_seg))
+    np.testing.assert_array_equal(got, np.zeros((C, S), np.int32))
+
+
+def test_masked_lanes_via_sentinel():
+    # lanes that don't compete are masked by writing the sentinel into
+    # EVERY slot (the engine's tgt_all = where(ok, path, NL) idiom):
+    # they must neither receive real ranks nor count as competitors
+    rng = np.random.default_rng(11)
+    C, S, n_seg = 32, 5, 19
+    seg = _unique_segs(rng, C, S, n_seg, mask_p=0.2)
+    key = rng.integers(0, 10_000, C).astype(np.int32)
+    competing = rng.random(C) < 0.6
+    seg_masked = np.where(competing[:, None], seg, n_seg).astype(np.int32)
+    got = np.asarray(
+        segmented_rank(jnp.asarray(seg_masked), jnp.asarray(key), n_seg)
+    )
+    want = matmul_oracle(seg_masked, key, n_seg, competitor=competing)
+    valid = seg_masked < n_seg
+    np.testing.assert_array_equal(got[valid], want[valid])
+
+
+@pytest.mark.parametrize("mesh", [(2, 2), (4, 4), (3, 2)])
+def test_engine_shaped_mesh_paths(mesh):
+    # real router-block shapes: concatenated request/reply XY legs over
+    # random (core tile, bank tile) pairs — reversed DIRECTED links, so
+    # the per-(lane, segment) uniqueness contract holds by construction
+    mx, my = mesh
+    cfg = small_test_config(
+        mx * my * 2, n_banks=8,
+        noc=NocConfig(mesh_x=mx, mesh_y=my, link_lat=1, router_lat=1),
+    )
+    C = cfg.n_cores
+    NL = n_links(cfg)
+    rng = np.random.default_rng(mx * 10 + my)
+    ctile = jnp.asarray(np.arange(C) % cfg.n_tiles, jnp.int32)
+    btile = jnp.asarray(rng.integers(0, cfg.n_tiles, C), jnp.int32)
+    req_p = path_links(cfg, ctile, btile)
+    rep_p = path_links(cfg, btile, ctile)
+    txn = rng.random(C) < 0.7
+    pth = np.concatenate([np.asarray(req_p), np.asarray(rep_p)], axis=1)
+    ok = txn[:, None] & (pth >= 0)
+    seg = np.where(ok, pth, NL).astype(np.int32)
+    key = ((rng.integers(0, 50, C) * C) + np.arange(C)).astype(np.int32)
+    got = np.asarray(segmented_rank(jnp.asarray(seg), jnp.asarray(key), NL))
+    want = matmul_oracle(seg, key, NL, competitor=txn)
+    np.testing.assert_array_equal(got[ok], want[ok])
+
+
+def test_faulted_detour_config_paths_stay_nominal_and_exact():
+    # fault-injection reroutes add latency AFTER the contention walk;
+    # the ranking itself always runs on the NOMINAL XY paths.  A config
+    # with link faults armed must therefore produce identical path sets
+    # — and identical sort-vs-matmul ranks — as the clean config.
+    cfg = small_test_config(8, n_banks=8)
+    cfg_f = small_test_config(
+        8, n_banks=8, faults_enabled=True, max_fault_events=1,
+        fault_events=((0, FAULT_LINK_FAIL, 1, 0),), fault_seed=123,
+    )
+    C, NL = cfg.n_cores, n_links(cfg)
+    rng = np.random.default_rng(5)
+    ctile = jnp.asarray(np.arange(C) % cfg.n_tiles, jnp.int32)
+    btile = jnp.asarray(rng.integers(0, cfg.n_tiles, C), jnp.int32)
+    p_clean = np.asarray(path_links(cfg, ctile, btile))
+    p_fault = np.asarray(path_links(cfg_f, ctile, btile))
+    np.testing.assert_array_equal(p_clean, p_fault)
+    seg = np.where(p_clean >= 0, p_clean, NL).astype(np.int32)
+    key = np.arange(C, 0, -1).astype(np.int32)
+    got = np.asarray(segmented_rank(jnp.asarray(seg), jnp.asarray(key), NL))
+    want = matmul_oracle(seg, key, NL)
+    valid = seg < NL
+    np.testing.assert_array_equal(got[valid], want[valid])
+
+
+def test_fleet_vmapped_batches_match_solo():
+    # the fleet engine vmaps the whole step: a batched segmented_rank
+    # must equal per-element calls bit-for-bit
+    rng = np.random.default_rng(21)
+    B, C, S, n_seg = 4, 24, 6, 15
+    segs = np.stack([_unique_segs(rng, C, S, n_seg) for _ in range(B)])
+    keys = rng.integers(0, 200, (B, C)).astype(np.int32)
+    batched = np.asarray(
+        jax.vmap(lambda s, k: segmented_rank(s, k, n_seg))(
+            jnp.asarray(segs), jnp.asarray(keys)
+        )
+    )
+    for b in range(B):
+        solo = np.asarray(
+            segmented_rank(jnp.asarray(segs[b]), jnp.asarray(keys[b]), n_seg)
+        )
+        np.testing.assert_array_equal(batched[b], solo, err_msg=f"elem {b}")
+
+
+def test_lane_order_properties():
+    key = jnp.asarray([5, 1, 5, 0, 9, 1], jnp.int32)
+    got = np.asarray(lane_order(key))
+    np.testing.assert_array_equal(got, [3, 1, 3, 0, 5, 1])
+    # strict-comparison agreement on random data incl. duplicates
+    rng = np.random.default_rng(3)
+    k = rng.integers(0, 30, 100).astype(np.int32)
+    o = np.asarray(lane_order(jnp.asarray(k)))
+    np.testing.assert_array_equal(
+        k[None, :] < k[:, None], o[None, :] < o[:, None]
+    )
+
+
+def test_precomputed_order_shared_across_calls():
+    rng = np.random.default_rng(9)
+    C, n_seg = 32, 12
+    key = rng.integers(0, 100, C).astype(np.int32)
+    seg = _unique_segs(rng, C, 4, n_seg)
+    ordr = lane_order(jnp.asarray(key))
+    a = segmented_rank(jnp.asarray(seg), jnp.asarray(key), n_seg)
+    b = segmented_rank(jnp.asarray(seg), n_seg=n_seg, order=ordr)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_packed_and_lex_agree_on_engine_scale():
+    rng = np.random.default_rng(17)
+    C, S, n_seg = 128, 12, 257
+    seg = _unique_segs(rng, C, S, n_seg)
+    key = rng.integers(0, 1 << 20, C).astype(np.int32)
+    a = np.asarray(segmented_rank(jnp.asarray(seg), jnp.asarray(key), n_seg,
+                                  method="packed"))
+    b = np.asarray(segmented_rank(jnp.asarray(seg), jnp.asarray(key), n_seg,
+                                  method="lex"))
+    valid = seg < n_seg
+    np.testing.assert_array_equal(a[valid], b[valid])

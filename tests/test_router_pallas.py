@@ -1,0 +1,185 @@
+"""Pallas router-cascade kernel (kernels/router_kernels.py, ISSUE 6):
+with `step_impl="pallas"` on a router-NoC config, the wait-floor +
+cummax-cascade + departure block runs as one VMEM kernel — and must be
+BIT-EXACT against both the golden scalar walk and the XLA step, on
+every workload generator, with the DRAM queue, under fault-injection
+detours, and fleet-vmapped.  Interpreter mode on CPU runs the identical
+kernel logic tier-1-gated; compiled on TPU."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import (
+    FAULT_CORE_FAILSTOP,
+    FAULT_LINK_DEGRADE,
+    FAULT_LINK_FAIL,
+    NocConfig,
+    small_test_config,
+)
+from primesim_tpu.trace import synth
+
+from test_parity import assert_parity
+from test_step_pallas import GENERATOR_TRACES, assert_xla_pallas_match
+
+
+def _router_cfg(**kw):
+    noc = NocConfig(
+        mesh_x=2, mesh_y=2, link_lat=1, router_lat=1,
+        contention=True, contention_model="router", contention_lat=2,
+    )
+    return small_test_config(8, n_banks=4, quantum=400, noc=noc, **kw)
+
+
+def _pallas(cfg):
+    return dataclasses.replace(cfg, step_impl="pallas")
+
+
+@pytest.mark.parametrize("gen", sorted(GENERATOR_TRACES))
+def test_three_way_router_parity_every_generator(gen):
+    # golden vs pallas (assert_parity) AND xla vs pallas full state on a
+    # ROUTER-contention machine: the cascade kernel sits in the hot path
+    # of every trace shape, sync and async alike
+    cfg = _router_cfg()
+    tr = GENERATOR_TRACES[gen]()
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr, chunk_steps=32)
+
+
+def test_router_plus_dram_queue_parity():
+    # both FIFO blocks live (shared lane_order feeds both segmented
+    # ranks); queue clocks carry across steps through the kernel path
+    cfg = _router_cfg(dram_queue=True, dram_service=8)
+    tr = synth.uniform_random(8, n_mem_ops=60, seed=31)
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr, chunk_steps=32)
+
+
+def test_router_local_runs_and_larger_mesh():
+    # rl > 0 composes (deferred run patches change t0 inputs), and a
+    # 4x4 mesh exercises H = 6 hop columns with multi-block cores
+    noc = NocConfig(
+        mesh_x=4, mesh_y=4, link_lat=2, router_lat=1,
+        contention=True, contention_model="router", contention_lat=3,
+    )
+    cfg = small_test_config(
+        16, n_banks=16, quantum=500, noc=noc, local_run_len=4
+    )
+    tr = synth.fft_like(16, n_phases=2, points_per_core=8, seed=32)
+    assert_parity(_pallas(cfg), tr, chunk_steps=32)
+    assert_xla_pallas_match(cfg, tr, chunk_steps=32)
+
+
+@pytest.mark.parametrize(
+    "events",
+    [
+        ((0, FAULT_LINK_FAIL, 1, 0),),
+        ((0, FAULT_LINK_DEGRADE, 2, 5), (4, FAULT_CORE_FAILSTOP, 3, 0)),
+    ],
+    ids=["link-fail-detour", "degrade+failstop"],
+)
+def test_router_fault_detours_compose_with_kernel(events):
+    # fault detour extras join AFTER the router walk (nominal paths):
+    # the kernel path must compose with them unchanged, xla == pallas
+    # on cycles, counters (noc_reroutes included), and full state
+    cfg = _router_cfg(
+        faults_enabled=True, max_fault_events=2,
+        fault_events=events, fault_seed=7,
+    )
+    tr = synth.uniform_random(8, n_mem_ops=50, seed=33)
+    assert_xla_pallas_match(cfg, tr, chunk_steps=32)
+
+
+def test_fleet_vmapped_router_kernel_bit_exact_vs_solo():
+    # the fleet vmaps the whole step including the cascade kernel: per
+    # element results must equal solo runs bit-for-bit, with traced knob
+    # overrides compiling ONCE
+    from primesim_tpu.sim.fleet import FleetEngine, apply_overrides
+
+    from test_fleet import assert_element_matches_solo
+
+    cfg = _pallas(_router_cfg(dram_queue=True, dram_service=6))
+    traces = [
+        synth.uniform_random(8, n_mem_ops=40, seed=41),
+        synth.barrier_phases(8, n_phases=3, seed=42),
+        synth.false_sharing(8, n_mem_ops=40, seed=43),
+    ]
+    overrides = [{}, {"link_lat": 3, "router_lat": 2}, {"quantum": 150}]
+    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
+    fleet.run()
+    assert fleet.done()
+    for i, (t, ov) in enumerate(zip(traces, overrides)):
+        assert_element_matches_solo(
+            fleet, i, apply_overrides(cfg, ov), t, chunk_steps=32
+        )
+
+
+def test_fleet_faulted_router_replay_solo_vs_vmapped():
+    # chaos acceptance: faults-on router runs replay bit-exactly solo vs
+    # fleet-vmapped through the kernel (counters included)
+    from primesim_tpu.sim.fleet import FleetEngine
+
+    from test_fleet import assert_element_matches_solo
+
+    cfg = _pallas(_router_cfg(
+        faults_enabled=True, max_fault_events=1,
+        fault_events=((2, FAULT_LINK_FAIL, 1, 0),), fault_seed=11,
+    ))
+    traces = [
+        synth.uniform_random(8, n_mem_ops=40, seed=44),
+        synth.stream(8, n_mem_ops=40, seed=45),
+    ]
+    fleet = FleetEngine(cfg, traces, chunk_steps=32)
+    fleet.run()
+    assert fleet.done()
+    for i, t in enumerate(traces):
+        assert_element_matches_solo(fleet, i, cfg, t, chunk_steps=32)
+
+
+def test_cascade_kernel_matches_xla_reference_directly():
+    # unit-level: random wait-floor inputs through router_cascade vs a
+    # straight jnp transcription of the engine's _cascade
+    import jax.numpy as jnp
+
+    from primesim_tpu.kernels.router_kernels import SENT, router_cascade
+
+    rng = np.random.default_rng(3)
+    C, H, legs = 16, 6, 3
+    LT = legs * H
+    lf = rng.integers(0, 900, (C, LT)).astype(np.int32)
+    bs = rng.integers(0, 900, (C, LT)).astype(np.int32)
+    r = rng.integers(0, 8, (C, LT)).astype(np.int32)
+    ok = rng.random((C, LT)) < 0.5
+    t0 = rng.integers(0, 500, C).astype(np.int32)
+    service = rng.integers(1, 60, C).astype(np.int32)
+    hops = [rng.integers(0, H + 1, C).astype(np.int32) for _ in range(3)]
+    L_lat, R_lat = 2, 3
+    c_hop = L_lat + R_lat
+    hidx = np.arange(H, dtype=np.int32)[None, :]
+
+    F = np.where(ok, np.maximum(lf, bs) + r * L_lat, SENT)
+
+    def cascade(t_start, Fl, nh):
+        G = Fl - hidx * c_hop
+        cum = np.maximum.accumulate(G, axis=1)
+        t1 = t_start + R_lat
+        t_end = np.maximum(t1, cum[:, -1]) + nh * c_hop
+        departs = np.maximum(t1[:, None], cum) + hidx * c_hop + L_lat
+        return t_end, departs
+
+    te_req, d_req = cascade(t0, F[:, :H], hops[0])
+    te_rep, d_rep = cascade(te_req + service, F[:, H : 2 * H], hops[1])
+    te_arr, d_arr = cascade(t0, F[:, 2 * H :], hops[2])
+
+    t_rep_end, t_arr_end, d_all = router_cascade(
+        jnp.asarray(lf), jnp.asarray(bs), jnp.asarray(r),
+        jnp.asarray(ok), jnp.asarray(t0), jnp.asarray(service),
+        jnp.asarray(hops[0]), jnp.asarray(hops[1]), jnp.asarray(hops[2]),
+        L_lat, R_lat, has_sync=True,
+    )
+    np.testing.assert_array_equal(np.asarray(t_rep_end), te_rep)
+    np.testing.assert_array_equal(np.asarray(t_arr_end), te_arr)
+    np.testing.assert_array_equal(
+        np.asarray(d_all), np.concatenate([d_req, d_rep, d_arr], axis=1)
+    )
