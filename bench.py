@@ -15,6 +15,10 @@ router contention + DRAM queue + O3 overlap — BASELINE config 3
 first-class gated metric since the sort-based FIFO ranking rework
 (DESIGN.md §13) put the full-fidelity rung on the perf frontier.
 
+`PRIMETPU_BENCH_SERVE=0` skips the serve_throughput measurement (the
+continuous-batching scheduler at sustained 8-slot occupancy vs the
+static batch-8 sweep).
+
 Rung-3 knobs: `PRIMETPU_BENCH_RUNG3=0` skips the rung-3 measurement;
 `PRIMETPU_BENCH_RUNG3_FLOOR=<mips>` makes the regression gate HARD
 (exit 1 below the floor). Without the env floor the gate is advisory
@@ -197,6 +201,58 @@ def main() -> None:
         wall_b = _measure_fleet(cfg1, trs, CHUNK)
         fleet_scaling[str(bsz)] = round(total_ins / wall_b / 1e6, 3)
 
+    # serve throughput: the continuous-batching scheduler (serve/) kept
+    # at sustained 8-slot occupancy on the same rung-1 config/workload as
+    # fleet_scaling — jobs/min and aggregate MIPS, with the static
+    # batch-8 sweep number alongside as the ceiling (the gap is
+    # splice/harvest/journal overhead + partial-occupancy drain at the
+    # tail). PRIMETPU_BENCH_SERVE=0 skips (metric reports null).
+    serve_detail = None
+    if os.environ.get("PRIMETPU_BENCH_SERVE", "1") != "0":
+        import tempfile
+
+        from primesim_tpu.serve import Job, JobJournal, Scheduler
+        from primesim_tpu.serve.scheduler import PAGE_EVENTS
+
+        synth_spec = (
+            "fft_like:n_phases=2,points_per_core=128,ins_per_mem=8,seed={}"
+        )
+        cap_pages = -(-max(t.max_len for t in fleet_traces) // PAGE_EVENTS)
+        n_jobs = 16
+        with tempfile.TemporaryDirectory() as td:
+            sched = Scheduler(
+                cfg1, JobJournal(td), td, buckets=((8, cap_pages),),
+                chunk_steps=CHUNK, max_queue=n_jobs + 1,
+                checkpoint_every_s=1e9,  # measure serving, not snapshots
+            )
+            warm = Job(job_id="warm", synth=synth_spec.format(51))
+            sched.submit(warm)
+            while not warm.terminal:
+                sched.tick()
+            jobs = [
+                Job(job_id=f"b{i:03d}", synth=synth_spec.format(60 + i))
+                for i in range(n_jobs)
+            ]
+            t0 = time.perf_counter()
+            for j in jobs:
+                sched.submit(j)
+            while not all(j.terminal for j in jobs):
+                sched.tick()
+            wall_srv = time.perf_counter() - t0
+            sched.journal.close()
+        served_ins = sum(
+            j.result["instructions"] for j in jobs if j.result
+        )
+        serve_detail = {
+            "jobs": n_jobs,
+            "slots": 8,
+            "jobs_per_min": round(n_jobs / wall_srv * 60.0, 2),
+            "aggregate_mips": round(served_ins / wall_srv / 1e6, 3),
+            "static_fleet8_mips": fleet_scaling["8"],
+            "states": sorted({j.state for j in jobs}),
+            "wall_s": round(wall_srv, 2),
+        }
+
     # LIVE per-phase cuts (scripts/prof/prof_phase.py source surgery) on
     # the headline machine: cumulative ms/step at each phase marker, so
     # every bench artifact carries the serial-chain decomposition next to
@@ -263,6 +319,9 @@ def main() -> None:
                     # (rung-1/64-core config, one distinct trace per
                     # element)
                     "fleet_scaling": fleet_scaling,
+                    # continuous-batching service throughput at sustained
+                    # 8-slot occupancy (null when PRIMETPU_BENCH_SERVE=0)
+                    "serve_throughput": serve_detail,
                     # STATIC RECORD: round-5 restructure evidence measured
                     # on TPU 2026-07-30 (scripts/prof/prof_phase.py
                     # cumulative cuts / prof_bisect.py ablations,

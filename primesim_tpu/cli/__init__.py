@@ -516,13 +516,15 @@ def cmd_sweep(ns) -> int:
         fleet, quarantined = build_fleet_isolated(
             cfg, sources, ovs, chunk_steps=ns.chunk_steps
         )
+    from ..serve.protocol import error_obj
+
     for i, err in quarantined:
         detail = {
             "engine": "fleet",
             "fleet_index": i,
             "status": "quarantined",
-            "error": str(err),
             "overrides": ovs[i],
+            **error_obj(err),  # structured {"error": {type, location, detail}}
         }
         if isinstance(err, TraceError):
             detail.update(err.location())
@@ -652,6 +654,17 @@ def cmd_sweep(ns) -> int:
             }
         )
     )
+    if quarantined or stalled:
+        # partial success is a distinct, scriptable outcome: the healthy
+        # elements' results are real (exit 0 would hide the casualties,
+        # exit 1 would discard the survivors)
+        print(
+            f"sweep: partial — {len(quarantined)} quarantined, "
+            f"{len(stalled)} stalled of "
+            f"{fleet.n_elements + len(quarantined)} elements",
+            file=sys.stderr,
+        )
+        return 3
     return 0
 
 
@@ -668,6 +681,137 @@ def cmd_synth(ns) -> int:
 
 def cmd_info(ns) -> int:
     print(_load_config(ns.config).to_json())
+    return 0
+
+
+def _parse_buckets(spec: str):
+    """'SLOTSxPAGES[,SLOTSxPAGES...]' -> ((slots, pages), ...) — the
+    serving fleet's paged capacity ladder (serve.scheduler)."""
+    out = []
+    for part in spec.split(","):
+        s, x, p = part.partition("x")
+        if not x or not s.isdigit() or not p.isdigit() \
+                or int(s) < 1 or int(p) < 1:
+            raise SystemExit(
+                f"bad --buckets entry {part!r} (want SLOTSxPAGES, e.g. 6x1)"
+            )
+        out.append((int(s), int(p)))
+    return tuple(out)
+
+
+def cmd_serve(ns) -> int:
+    """Start the continuous-batching simulation daemon (DESIGN.md §14):
+    one compiled fleet program per capacity bucket, jobs spliced into
+    slots as elements retire, WAL-journaled so kill -9 loses nothing.
+    SIGTERM drains (checkpoint + exit 75 when work remains); SIGHUP
+    reloads --config's fault schedule (same geometry only)."""
+    cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
+    from ..serve.server import PrimeServer
+
+    server = PrimeServer(
+        cfg,
+        state_dir=ns.state_dir,
+        socket_path=ns.socket,
+        buckets=_parse_buckets(ns.buckets),
+        chunk_steps=ns.chunk_steps,
+        max_queue=ns.max_queue,
+        checkpoint_every_s=ns.checkpoint_wall,
+        config_path=ns.config,
+        idle_exit_s=ns.idle_exit,
+    )
+    print(
+        f"serve: listening on {server.socket_path} "
+        f"(slots={server.sched.total_slots}, "
+        f"recovered={server.recovered['jobs_requeued']} job(s))",
+        file=sys.stderr,
+    )
+    rc = server.serve_forever()
+    if ns.report:
+        import numpy as np
+
+        from ..stats.counters import COUNTER_NAMES
+        from ..stats.report import write_report
+
+        # the aggregate SERVICE report: per-core counter/cycle axes are
+        # not meaningful across heterogeneous jobs, so they render zero
+        # and the SERVICE section carries the data
+        write_report(
+            ns.report, cfg,
+            {k: np.zeros(cfg.n_cores, np.int64) for k in COUNTER_NAMES},
+            np.zeros(cfg.n_cores, np.int64),
+            title="primetpu serve",
+            service=server.sched.service_report(),
+        )
+        print(f"report written to {ns.report}", file=sys.stderr)
+    print(
+        f"serve: drained rc={rc} "
+        f"({json.dumps(server.sched.service_report())})",
+        file=sys.stderr,
+    )
+    return rc
+
+
+def cmd_submit(ns) -> int:
+    """Submit one job to a running daemon; with --wait, block for the
+    terminal state and print the full result record."""
+    from ..serve.client import ServeClient, ServeError
+
+    cli = ServeClient(ns.socket)
+    overrides = {}
+    for spec in ns.vary or []:
+        overrides.update(_parse_vary(spec))
+    try:
+        job = cli.submit(
+            trace_path=ns.trace,
+            synth=ns.synth,
+            overrides=overrides,
+            fold=ns.fold,
+            deadline_s=ns.deadline,
+            max_steps=ns.max_steps or 10_000_000,
+            priority=ns.priority,
+            client=ns.client,
+            retries=ns.retries,
+        )
+        if ns.wait:
+            job = cli.wait(job["job_id"], timeout_s=ns.timeout)
+    except ServeError as e:
+        out = {"ok": False, "error": e.error}
+        if e.retry_after_s is not None:
+            out["retry_after_s"] = e.retry_after_s
+        print(json.dumps(out))
+        return 4 if e.retry_after_s is not None else 1
+    except OSError as e:
+        from ..serve.protocol import error_obj
+
+        print(json.dumps({"ok": False, **error_obj(e)}))
+        return 1
+    print(json.dumps({"ok": True, "job": job}))
+    if ns.wait and job["state"] != "DONE":
+        return 1
+    return 0
+
+
+def cmd_serve_status(ns) -> int:
+    """Query a running daemon: health (default), --jobs listing, or
+    --drain (ask it to finish the queue and exit)."""
+    from ..serve.client import ServeClient, ServeError
+
+    cli = ServeClient(ns.socket)
+    try:
+        if ns.drain:
+            print(json.dumps(cli.drain()))
+        elif ns.jobs:
+            print(json.dumps(cli.status()))
+        else:
+            print(json.dumps(cli.health()))
+    except ServeError as e:
+        print(json.dumps({"ok": False, "error": e.error}))
+        return 1
+    except OSError as e:
+        from ..serve.protocol import error_obj
+
+        print(json.dumps({"ok": False, **error_obj(e)}))
+        return 1
     return 0
 
 
@@ -861,19 +1005,116 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("info", help="parse + print a machine config")
     i.add_argument("config")
     i.set_defaults(fn=cmd_info)
+
+    v = sub.add_parser(
+        "serve",
+        help="run the continuous-batching simulation daemon (jobs over a "
+             "unix socket; WAL-journaled, crash-safe, drains on SIGTERM)",
+    )
+    v.add_argument("config", help="machine config (.json or .xml)")
+    v.add_argument(
+        "--state-dir", required=True, metavar="DIR",
+        help="journal + per-job checkpoints + default socket live here; "
+             "restarting with the same DIR resumes every unfinished job",
+    )
+    v.add_argument(
+        "--socket", default=None, metavar="PATH",
+        help="unix socket path (default: STATE_DIR/serve.sock)",
+    )
+    v.add_argument(
+        "--buckets", default="6x1,2x8", metavar="SxP[,SxP...]",
+        help="capacity ladder: SLOTSxPAGES per bucket, one compiled fleet "
+             "each, page = 64 event slots/core (default 6x1,2x8)",
+    )
+    v.add_argument("--chunk-steps", type=int, default=128)
+    v.add_argument(
+        "--max-queue", type=int, default=64, metavar="N",
+        help="pending-queue bound; submits past it get RETRY_AFTER",
+    )
+    v.add_argument(
+        "--checkpoint-wall", type=float, default=2.0, metavar="SEC",
+        help="element-checkpoint in-flight jobs every SEC wall-seconds",
+    )
+    v.add_argument(
+        "--idle-exit", type=float, default=None, metavar="SEC",
+        help="exit 0 after SEC seconds with nothing queued or running "
+             "(one-shot/CI mode; default: serve forever)",
+    )
+    v.add_argument(
+        "--step-impl", choices=("xla", "pallas"), default=None,
+        help="step implementation for the serving fleets",
+    )
+    v.add_argument(
+        "--report", metavar="PATH",
+        help="write a text report with the SERVICE section at drain",
+    )
+    _add_fault_flags(v)
+    v.set_defaults(fn=cmd_serve)
+
+    b = sub.add_parser(
+        "submit",
+        help="submit one job to a running `primetpu serve` daemon",
+    )
+    b.add_argument("--socket", required=True, metavar="PATH")
+    b.add_argument("--trace", help="PTPU trace file (server-side path)")
+    b.add_argument("--synth", help="synthetic workload spec name[:k=v,...]")
+    b.add_argument(
+        "--vary", action="append", metavar="K=V[,K=V...]",
+        help="timing overrides for this job (same keys as sweep --vary)",
+    )
+    b.add_argument("--fold", action="store_true")
+    b.add_argument(
+        "--deadline", type=float, default=None, metavar="SEC",
+        help="wall-clock budget from acceptance; expiry -> TIMEOUT",
+    )
+    b.add_argument("--max-steps", type=int, default=None)
+    b.add_argument("--priority", type=int, default=0)
+    b.add_argument("--client", default="anon")
+    b.add_argument(
+        "--retries", type=int, default=0, metavar="N",
+        help="honor RETRY_AFTER backpressure up to N resubmits",
+    )
+    b.add_argument(
+        "--wait", action="store_true",
+        help="block until the job is terminal; exit 0 only on DONE",
+    )
+    b.add_argument("--timeout", type=float, default=300.0, metavar="SEC")
+    b.set_defaults(fn=cmd_submit)
+
+    t = sub.add_parser(
+        "serve-status",
+        help="healthz for a running daemon (queue depth, occupancy, "
+             "aggregate MIPS, latency percentiles)",
+    )
+    t.add_argument("--socket", required=True, metavar="PATH")
+    t.add_argument(
+        "--jobs", action="store_true", help="list every known job instead"
+    )
+    t.add_argument(
+        "--drain", action="store_true",
+        help="ask the daemon to finish its queue and exit",
+    )
+    t.set_defaults(fn=cmd_serve_status)
     return p
 
 
 def main(argv=None) -> int:
     ns = build_parser().parse_args(argv)
     from ..config.machine import FaultConfigError
+    from ..sim.checkpoint import CheckpointCorrupt
+    from ..trace.format import TraceError
 
     try:
         return ns.fn(ns)
-    except FaultConfigError as e:
-        # typed schedule/config errors carry (site, step, field) — show
-        # the operator exactly which entry is wrong
-        print(f"fault config error: {e} [{e.location()}]", file=sys.stderr)
+    except (TraceError, FaultConfigError, CheckpointCorrupt) as e:
+        # typed errors exit 2 with ONE structured JSON line on stderr —
+        # {"error": {type, location, detail}} — the same shape the serve
+        # protocol and sweep quarantine lines use, so scripts parse one
+        # grammar everywhere (location carries core/offset for traces,
+        # site/step/field for fault schedules)
+        from ..serve.protocol import error_obj
+
+        print(json.dumps(error_obj(e)), file=sys.stderr)
         return 2
     except BrokenPipeError:  # e.g. `primetpu info cfg | head`
         return 0

@@ -1,0 +1,73 @@
+"""primesim_tpu.serve — crash-safe continuous-batching simulation service.
+
+`primetpu serve` owns one compiled fleet program per capacity bucket and
+splices client jobs into free slots as elements retire; every accepted
+job is journaled (WAL) and checkpointed so a `kill -9` loses nothing.
+See DESIGN.md §14 and README "Serving simulations".
+
+Light modules (jobs, journal, protocol, client) import eagerly; the
+scheduler/server (which pull in the JAX-backed fleet) resolve lazily so
+`import primesim_tpu.serve` stays cheap for clients and error paths.
+"""
+
+from .client import ServeClient, ServeError
+from .jobs import (
+    CANCELLED,
+    DONE,
+    FAILED,
+    PENDING,
+    QUARANTINED,
+    RUNNING,
+    TERMINAL_STATES,
+    TIMEOUT,
+    Job,
+)
+from .journal import JobJournal, JournalCorrupt, fold_records
+from .protocol import error_obj
+
+_LAZY = {
+    "Scheduler": "scheduler",
+    "SlotBucket": "scheduler",
+    "QueueFull": "scheduler",
+    "DEFAULT_BUCKETS": "scheduler",
+    "PAGE_EVENTS": "scheduler",
+    "materialize_workload": "scheduler",
+    "PrimeServer": "server",
+    "EX_TEMPFAIL": "server",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
+__all__ = [
+    "CANCELLED",
+    "DEFAULT_BUCKETS",
+    "DONE",
+    "EX_TEMPFAIL",
+    "FAILED",
+    "Job",
+    "JobJournal",
+    "JournalCorrupt",
+    "PAGE_EVENTS",
+    "PENDING",
+    "PrimeServer",
+    "QUARANTINED",
+    "QueueFull",
+    "RUNNING",
+    "Scheduler",
+    "ServeClient",
+    "ServeError",
+    "SlotBucket",
+    "TERMINAL_STATES",
+    "TIMEOUT",
+    "error_obj",
+    "fold_records",
+    "materialize_workload",
+]

@@ -1,0 +1,96 @@
+"""Client for a running `primetpu serve` daemon — thin verb wrappers over
+the JSON-lines protocol, used by `primetpu submit` / `primetpu
+serve-status` and directly by tests."""
+
+from __future__ import annotations
+
+import time
+
+from .protocol import request
+
+
+class ServeError(RuntimeError):
+    """Server replied `ok: false`. Carries the structured error object
+    and the backpressure hint when one was offered."""
+
+    def __init__(self, reply: dict):
+        err = reply.get("error") or {}
+        super().__init__(err.get("detail") or "server error")
+        self.reply = reply
+        self.error = err
+        self.retry_after_s = reply.get("retry_after_s")
+
+
+class ServeClient:
+    def __init__(self, socket_path: str, timeout_s: float = 30.0):
+        self.socket_path = str(socket_path)
+        self.timeout_s = float(timeout_s)
+
+    def _call(self, req: dict, timeout_s: float | None = None) -> dict:
+        reply = request(
+            self.socket_path, req,
+            timeout_s=self.timeout_s if timeout_s is None else timeout_s,
+        )
+        if not reply.get("ok", False):
+            raise ServeError(reply)
+        return reply
+
+    def submit(
+        self,
+        trace_path: str | None = None,
+        synth: str | None = None,
+        overrides: dict | None = None,
+        fold: bool = True,
+        deadline_s: float | None = None,
+        max_steps: int = 10_000_000,
+        priority: int = 0,
+        client: str = "anon",
+        retries: int = 0,
+    ) -> dict:
+        """Submit one job; the reply's job is ACKed = durably journaled.
+        With `retries`, honors RETRY_AFTER backpressure by sleeping and
+        resubmitting up to that many times."""
+        req = {
+            "verb": "submit",
+            "trace_path": trace_path,
+            "synth": synth,
+            "overrides": dict(overrides or {}),
+            "fold": fold,
+            "deadline_s": deadline_s,
+            "max_steps": max_steps,
+            "priority": priority,
+            "client": client,
+        }
+        attempt = 0
+        while True:
+            try:
+                return self._call(req)["job"]
+            except ServeError as e:
+                if e.retry_after_s is None or attempt >= retries:
+                    raise
+                attempt += 1
+                time.sleep(float(e.retry_after_s))
+
+    def status(self, job_id: str | None = None) -> dict | list:
+        reply = self._call({"verb": "status", "job_id": job_id})
+        return reply["job"] if job_id else reply["jobs"]
+
+    def result(self, job_id: str) -> dict:
+        return self._call({"verb": "result", "job_id": job_id})
+
+    def wait(self, job_id: str, timeout_s: float = 300.0) -> dict:
+        """Block until the job is terminal; returns its public view."""
+        reply = self._call(
+            {"verb": "wait", "job_id": job_id, "timeout_s": timeout_s},
+            timeout_s=timeout_s + 10.0,
+        )
+        return reply["job"]
+
+    def cancel(self, job_id: str) -> dict:
+        return self._call({"verb": "cancel", "job_id": job_id})["job"]
+
+    def health(self) -> dict:
+        return self._call({"verb": "health"})
+
+    def drain(self) -> dict:
+        return self._call({"verb": "drain"})

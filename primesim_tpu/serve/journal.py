@@ -1,0 +1,181 @@
+"""Crash-safe job journal — a WAL for the serving daemon (DESIGN.md §14).
+
+One append-only JSON-lines file, `journal.jsonl`, holding every fact the
+server must not lose across a `kill -9`: job acceptances and state
+transitions. Durability discipline mirrors `checkpoint.atomic_save_npz`
+adapted to an append-only log:
+
+- every record is framed `{"c": crc32(payload_json), "r": payload}` so a
+  torn or bit-rotted line is detected before it is trusted;
+- `append()` writes the line, flushes, and `fsync`s BEFORE returning —
+  the server only ACKs a submission after its accept record is durable,
+  which is the whole crash-safety invariant: ACKed => journaled =>
+  replayed => reaches a terminal state;
+- the journal directory is fsynced once at creation so the file's own
+  existence survives power loss (same dir-fsync the atomic saver does).
+
+Replay walks the file in order and tolerates a torn TAIL (the one
+partial line a crash mid-append can leave): parsing stops at the first
+bad record and reports how many trailing lines were dropped. A bad
+record can only be the unACKed last append, so nothing acknowledged is
+ever lost. Mid-file corruption (bad CRC with valid records after it)
+means the medium rotted, not a torn append — that raises
+`JournalCorrupt` rather than silently resurrecting half a history.
+
+Record types (`t` field): `accept` (the Job accept_record), `state`
+(job_id + new state + detail/result), `drain` (clean shutdown marker),
+`note` (operator-visible annotations: schedule reloads, recovery stats).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+
+class JournalCorrupt(ValueError):
+    """Mid-file journal corruption: a record failed its CRC while later
+    records are intact — media rot, not a torn append. Distinct from the
+    tolerated torn tail (see module docstring)."""
+
+
+def _frame(rec: dict) -> str:
+    payload = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+    return json.dumps(
+        {"c": zlib.crc32(payload.encode()), "r": rec},
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+def _unframe(line: str) -> dict | None:
+    """Decode + CRC-verify one journal line; None when unusable."""
+    try:
+        obj = json.loads(line)
+        rec = obj["r"]
+        payload = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+        if zlib.crc32(payload.encode()) != int(obj["c"]):
+            return None
+        return rec
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+class JobJournal:
+    """Append-only fsynced record log in `directory/journal.jsonl`."""
+
+    def __init__(self, directory: str):
+        self.dir = str(directory)
+        self.path = os.path.join(self.dir, "journal.jsonl")
+        fresh = not os.path.isdir(self.dir)
+        os.makedirs(self.dir, exist_ok=True)
+        if fresh:
+            dfd = os.open(
+                os.path.dirname(os.path.abspath(self.dir)) or ".",
+                os.O_RDONLY,
+            )
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        self._f = open(self.path, "a", encoding="utf-8")
+        self.appended = 0
+
+    # ---- write side ------------------------------------------------------
+
+    def append(self, rec: dict) -> None:
+        """Durably append one record: write + flush + fsync. The caller
+        may ACK the fact the record carries only AFTER this returns."""
+        self._f.write(_frame(rec) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.appended += 1
+
+    def accept(self, job) -> None:
+        self.append({"t": "accept", "job": job.accept_record()})
+
+    def state(self, job_id: str, state: str, detail: dict | None = None,
+              result: dict | None = None) -> None:
+        rec = {"t": "state", "job_id": job_id, "state": state}
+        if detail:
+            rec["detail"] = detail
+        if result is not None:
+            rec["result"] = result
+        self.append(rec)
+
+    def note(self, msg: str) -> None:
+        self.append({"t": "note", "msg": str(msg)})
+
+    def drain(self) -> None:
+        self.append({"t": "drain"})
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    # ---- read side -------------------------------------------------------
+
+    def replay(self) -> tuple[list[dict], int]:
+        """All valid records in append order, plus the count of dropped
+        torn-TAIL lines (0 on a clean log). Raises JournalCorrupt when a
+        bad record is followed by valid ones (mid-file rot)."""
+        if not os.path.exists(self.path):
+            return [], 0
+        with open(self.path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        records: list[dict] = []
+        bad_at: int | None = None
+        for n, line in enumerate(lines):
+            if not line.strip():
+                continue
+            rec = _unframe(line)
+            if rec is None:
+                if bad_at is None:
+                    bad_at = n
+                continue
+            if bad_at is not None:
+                raise JournalCorrupt(
+                    f"{self.path}: record at line {bad_at + 1} fails CRC "
+                    f"but line {n + 1} is valid — mid-file corruption"
+                )
+            records.append(rec)
+        dropped = (len(lines) - bad_at) if bad_at is not None else 0
+        return records, dropped
+
+
+def fold_records(records: list[dict]):
+    """Fold a replayed record stream into the job table the scheduler
+    restarts from: `(jobs, clean_drain)` where `jobs` maps job_id ->
+    rebuilt Job (terminal jobs carry their journaled result; non-terminal
+    ones are back in PENDING, ready to re-enqueue) and `clean_drain` is
+    True when the log ends with a drain marker (graceful shutdown)."""
+    from .jobs import RUNNING, TERMINAL_STATES, Job
+
+    jobs: dict[str, Job] = {}
+    clean_drain = False
+    for rec in records:
+        t = rec.get("t")
+        if t == "accept":
+            job = Job.from_accept_record(rec["job"])
+            jobs[job.job_id] = job
+            clean_drain = False
+        elif t == "state":
+            job = jobs.get(rec["job_id"])
+            if job is None:
+                continue  # state for a job we never saw accepted
+            state = rec["state"]
+            if state in TERMINAL_STATES:
+                job.state = state
+                job.detail = rec.get("detail") or {}
+                job.result = rec.get("result")
+                job.finished_t = job.accepted_t  # latency lost across crash
+            elif state == RUNNING:
+                # mid-flight at crash: back to PENDING for re-admission
+                job.state = "PENDING"
+            clean_drain = False
+        elif t == "drain":
+            clean_drain = True
+    return jobs, clean_drain

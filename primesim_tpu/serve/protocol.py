@@ -1,0 +1,90 @@
+"""Wire protocol for `primetpu serve` — JSON lines over a unix socket.
+
+Each request and each reply is one JSON object on one line (UTF-8,
+newline-terminated). Requests carry a `verb`; replies carry `ok: bool`
+plus verb-specific fields, or `ok: false` with a structured `error`
+object (same shape the CLI emits for run/sweep failures):
+
+    {"error": {"type": "TraceError", "location": {...}, "detail": "..."}}
+
+Verbs:
+    submit  {trace_path|synth, overrides?, fold?, deadline_s?,
+             max_steps?, priority?, client?}       -> {job_id} | RETRY_AFTER
+    status  {job_id?}                              -> {job}|{jobs}
+    result  {job_id}                               -> {job} (terminal only)
+    wait    {job_id, timeout_s?}                   -> {job} once terminal
+    cancel  {job_id}                               -> {job}
+    health  {}                                     -> service stats
+    drain   {}                                     -> ack; server checkpoints
+                                                      in-flight work and exits
+
+Backpressure: a submit against a full queue gets
+`{"ok": false, "retry_after_s": <float>, "error": {...}}` — the client
+is expected to back off, not spin.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+MAX_LINE = 1 << 20  # 1 MiB per message — traces travel by path, not value
+
+
+def error_obj(exc: BaseException) -> dict:
+    """Structured error payload for an exception: stable `type`, the
+    exception's own `location()` dict when it has one (TraceError,
+    FaultConfigError carry source coordinates), and the message."""
+    loc = {}
+    locate = getattr(exc, "location", None)
+    if callable(locate):
+        try:
+            loc = dict(locate())
+        except Exception:
+            loc = {}
+    return {
+        "error": {
+            "type": type(exc).__name__,
+            "location": loc,
+            "detail": str(exc),
+        }
+    }
+
+
+def encode(obj: dict) -> bytes:
+    line = json.dumps(obj, sort_keys=True, separators=(",", ":"))
+    data = line.encode() + b"\n"
+    if len(data) > MAX_LINE:
+        raise ValueError(f"message of {len(data)} bytes exceeds {MAX_LINE}")
+    return data
+
+
+def decode(line: bytes | str) -> dict:
+    obj = json.loads(line)
+    if not isinstance(obj, dict):
+        raise ValueError("protocol message must be a JSON object")
+    return obj
+
+
+def read_line(f) -> dict | None:
+    """Read one framed message from a file-like socket reader; None on
+    EOF (peer closed)."""
+    line = f.readline(MAX_LINE + 1)
+    if not line:
+        return None
+    if len(line) > MAX_LINE:
+        raise ValueError("oversized protocol message")
+    return decode(line)
+
+
+def request(sock_path: str, req: dict, timeout_s: float = 30.0) -> dict:
+    """One request/reply round trip against the server socket."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(timeout_s)
+        s.connect(sock_path)
+        s.sendall(encode(req))
+        f = s.makefile("rb")
+        reply = read_line(f)
+    if reply is None:
+        raise ConnectionError(f"server at {sock_path} closed without reply")
+    return reply

@@ -1,0 +1,622 @@
+"""Continuous-batching scheduler: jobs in, fleet slots spliced, results out.
+
+The scheduler owns one compiled fleet program per CAPACITY BUCKET and
+never recompiles during service. A bucket is `n_slots` batch elements
+whose event storage is `n_pages * page_events` slots per core
+(`FleetEngine.make_slots`); admission routes each job to the
+smallest-capacity bucket its trace fits, so short traces don't pay the
+worst-case [B, C, T] shape — the paged/pooled allocator the fleet's
+fixed-shape splice contract makes possible.
+
+One `tick()` is the serving round:
+
+    expire deadlines -> splice pending jobs into free slots ->
+    one committed chunk per busy bucket -> harvest retired elements ->
+    periodic per-job element checkpoints
+
+Every state transition is journaled BEFORE the slot is recycled, and
+in-flight jobs are checkpointed to deterministic per-job paths
+(`<dir>/jobs/<job_id>.npz`), so the restart path (server.py) can rebuild
+exactly this table from the journal + checkpoint files alone.
+
+Failure containment: a batch dispatch failure cannot be attributed to
+one element from the exception, so the whole bucket rolls back — its
+fleet is rebuilt all-idle (host arrays are authoritative) and each
+occupant consults its `JobContext` retry budget: transient/oom failures
+re-enqueue with exponential backoff (resuming from the newest element
+checkpoint), permanent ones go FAILED. A job whose workload won't even
+validate never reaches a fleet: it is QUARANTINED at admission, exactly
+like `sweep --isolate` does for bad elements.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..sim.fleet import FleetEngine, apply_overrides
+from ..sim.supervisor import JobContext, validate_fleet_element
+from . import jobs as J
+from .protocol import error_obj
+
+#: One event-storage page, in per-core event slots. Bucket capacities are
+#: whole pages: (slots, pages) -> capacity = pages * PAGE_EVENTS.
+PAGE_EVENTS = 64
+
+#: Default bucket ladder: small/large. Most synthetic traces fit one page.
+DEFAULT_BUCKETS = ((6, 1), (2, 8))
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: the bounded queue is at capacity. Carries the
+    backpressure hint the protocol surfaces as `retry_after_s`."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"queue full ({depth} pending); retry after {retry_after_s:.1f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+def parse_synth_spec(spec: str, n_cores: int, fold: bool):
+    """`name:k=v,...` -> Trace (the CLI's --synth grammar, but raising
+    ValueError instead of SystemExit so a bad spec quarantines the job
+    with a structured error rather than killing the daemon)."""
+    from ..trace import synth
+    from ..trace.format import fold_ins
+
+    name, _, args = spec.partition(":")
+    if name not in synth.GENERATORS:
+        raise ValueError(
+            f"unknown generator {name!r}; have: "
+            f"{', '.join(sorted(synth.GENERATORS))}"
+        )
+    kw = {}
+    if args:
+        for pair in args.split(","):
+            k, eq, v = pair.partition("=")
+            if not eq or not k:
+                raise ValueError(f"bad synth arg {pair!r} (want key=value)")
+            try:
+                kw[k] = int(v)
+            except ValueError:
+                raise ValueError(
+                    f"bad synth arg {pair!r}: value must be an integer"
+                ) from None
+    try:
+        tr = synth.GENERATORS[name](n_cores, **kw)
+    except TypeError as e:
+        raise ValueError(f"synth {name!r}: {e}") from None
+    return fold_ins(tr) if fold else tr
+
+
+def materialize_workload(job: J.Job, cfg):
+    """Load/generate the job's trace from its journaled SPEC and compute
+    its effective config. Deterministic — re-running it after a crash
+    yields the identical workload, which is what makes replay bit-exact.
+    Raises (TraceError/ValueError/OSError) when the workload is bad; the
+    caller quarantines."""
+    from ..trace.format import Trace, fold_ins
+
+    if (job.trace_path is None) == (job.synth is None):
+        raise ValueError("job needs exactly one of trace_path | synth")
+    if job.trace_path is not None:
+        tr = Trace.load(job.trace_path)
+        if job.fold:
+            tr = fold_ins(tr)
+    else:
+        tr = parse_synth_spec(job.synth, cfg.n_cores, job.fold)
+    ecfg = apply_overrides(cfg, job.overrides)
+    validate_fleet_element(cfg, tr, job.overrides)
+    job._trace = tr
+    job._elem_cfg = ecfg
+    job._ctx = JobContext()
+    return tr
+
+
+class SlotBucket:
+    """One compiled fleet + its slot table. `slots[i]` is the occupying
+    Job or None; the fleet element under a None slot holds `idle_trace`
+    and contributes nothing to the vmapped step."""
+
+    def __init__(self, cfg, n_slots: int, n_pages: int,
+                 chunk_steps: int = 128):
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.n_pages = int(n_pages)
+        self.capacity = int(n_pages) * PAGE_EVENTS
+        self.chunk_steps = int(chunk_steps)
+        self.fleet = FleetEngine.make_slots(
+            cfg, self.n_slots, self.capacity, chunk_steps=self.chunk_steps
+        )
+        self.slots: list[J.Job | None] = [None] * self.n_slots
+
+    def free_slot(self) -> int | None:
+        for i, occ in enumerate(self.slots):
+            if occ is None:
+                return i
+        return None
+
+    @property
+    def occupied(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def busy(self) -> bool:
+        """Any occupied slot still running (not yet harvested)?"""
+        if self.occupied == 0:
+            return False
+        dm = self.fleet.done_mask()
+        return any(
+            s is not None and not dm[i] for i, s in enumerate(self.slots)
+        )
+
+    def rebuild(self) -> None:
+        """Host rollback after a failed dispatch: throw the (possibly
+        poisoned) device state away and start an all-idle fleet on the
+        same compiled geometry. Occupants must be re-enqueued by the
+        caller BEFORE this runs."""
+        self.fleet = FleetEngine.make_slots(
+            self.cfg, self.n_slots, self.capacity,
+            chunk_steps=self.chunk_steps,
+        )
+        self.slots = [None] * self.n_slots
+
+
+class Scheduler:
+    """The serving core. Owns the job table, the bounded pending queue,
+    the bucket fleets, and the journal write side. Single-threaded by
+    design — the server's listener threads only ENQUEUE closures onto
+    `self.inbox`; every mutation happens on the tick loop."""
+
+    def __init__(
+        self,
+        cfg,
+        journal,
+        state_dir: str,
+        buckets=DEFAULT_BUCKETS,
+        chunk_steps: int = 128,
+        max_queue: int = 64,
+        checkpoint_every_s: float = 2.0,
+        max_retries: int = 2,
+    ):
+        self.cfg = cfg
+        self.journal = journal
+        self.state_dir = str(state_dir)
+        self.jobs_dir = os.path.join(self.state_dir, "jobs")
+        os.makedirs(self.jobs_dir, exist_ok=True)
+        self.buckets = [
+            SlotBucket(cfg, n, p, chunk_steps=chunk_steps)
+            for n, p in sorted(buckets, key=lambda b: b[1])
+        ]
+        self.max_queue = int(max_queue)
+        self.checkpoint_every_s = float(checkpoint_every_s)
+        self.max_retries = int(max_retries)
+        self.jobs: dict[str, J.Job] = {}
+        self.queue: list[str] = []  # pending job_ids, accept order
+        self._seq = 0
+        self._last_pick: dict[str, int] = {}  # client -> rr stamp
+        self._pick_n = 0
+        self._last_ckpt_t = time.time()
+        self._backoff_until = 0.0
+        self.started_t = time.time()
+        self.total_instructions = 0
+        self.completed = 0
+        self._latencies: list[float] = []  # terminal latencies, capped
+
+    # ---- identity / paths ------------------------------------------------
+
+    def next_job_id(self) -> str:
+        self._seq += 1
+        return f"j{self._seq:06d}"
+
+    def job_ckpt_path(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, f"{job_id}.npz")
+
+    @property
+    def total_slots(self) -> int:
+        return sum(b.n_slots for b in self.buckets)
+
+    @property
+    def max_capacity(self) -> int:
+        return max(b.capacity for b in self.buckets)
+
+    # ---- admission -------------------------------------------------------
+
+    def submit(self, job: J.Job) -> J.Job:
+        """Admit one job: backpressure check, durable accept record
+        (fsynced BEFORE this returns — the ACK invariant), workload
+        validation (bad -> QUARANTINED), enqueue."""
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(
+                len(self.queue), retry_after_s=1.0 + 0.1 * len(self.queue)
+            )
+        self.jobs[job.job_id] = job
+        self.journal.accept(job)
+        self._validate_or_quarantine(job)
+        if not job.terminal:
+            self.queue.append(job.job_id)
+        return job
+
+    def _validate_or_quarantine(self, job: J.Job) -> bool:
+        try:
+            tr = materialize_workload(job, self.cfg)
+        except Exception as e:  # bad workload must not kill the daemon
+            self._terminal(job, J.QUARANTINED, detail=error_obj(e)["error"])
+            return False
+        if tr.max_len > self.max_capacity:
+            self._terminal(
+                job,
+                J.QUARANTINED,
+                detail={
+                    "type": "CapacityError",
+                    "location": {},
+                    "detail": (
+                        f"trace needs {tr.max_len} event slots/core; "
+                        f"largest bucket holds {self.max_capacity}"
+                    ),
+                },
+            )
+            return False
+        return True
+
+    def requeue_recovered(self, job: J.Job) -> None:
+        """Journal-replayed non-terminal job: re-materialize its workload
+        from the accept facts, point it at its newest element checkpoint
+        when one survived, and put it back in line."""
+        self.jobs[job.job_id] = job
+        if not self._validate_or_quarantine(job):
+            return
+        if os.path.exists(self.job_ckpt_path(job.job_id)):
+            job._resume_from = self.job_ckpt_path(job.job_id)
+        self.queue.append(job.job_id)
+
+    def adopt_terminal(self, job: J.Job) -> None:
+        """Journal-replayed job already in a terminal state: keep it for
+        STATUS/RESULT queries; nothing to run."""
+        self.jobs[job.job_id] = job
+
+    def cancel(self, job_id: str) -> J.Job:
+        job = self.jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job {job_id!r}")
+        if job.terminal:
+            raise ValueError(f"{job_id} already terminal ({job.state})")
+        if job.state == J.PENDING and job_id in self.queue:
+            self.queue.remove(job_id)
+        elif job.state == J.RUNNING:
+            self._evict(job)
+        self._terminal(job, J.CANCELLED, detail={"detail": "client cancel"})
+        return job
+
+    # ---- the serving tick ------------------------------------------------
+
+    def tick(self) -> bool:
+        """One serving round. Returns True when any device work ran (the
+        server idles its loop when False)."""
+        now = time.time()
+        self._expire_deadlines(now)
+        if now >= self._backoff_until:
+            self._fill_slots()
+        worked = False
+        for b in self.buckets:
+            if not b.busy():
+                continue
+            try:
+                b.fleet.step_chunk()
+                worked = True
+            except Exception as e:  # noqa: BLE001 — classified below
+                self._dispatch_failed(b, e)
+                return True
+        self._harvest(now)
+        if now - self._last_ckpt_t >= self.checkpoint_every_s:
+            self.checkpoint_running()
+            self._last_ckpt_t = now
+        return worked
+
+    def _expire_deadlines(self, now: float) -> None:
+        for job_id in list(self.queue):
+            job = self.jobs[job_id]
+            if job.deadline_expired(now):
+                self.queue.remove(job_id)
+                self._terminal(
+                    job, J.TIMEOUT,
+                    detail={"detail": f"deadline {job.deadline_s}s expired "
+                                      "in queue"},
+                )
+        for b in self.buckets:
+            for i, job in enumerate(b.slots):
+                if job is not None and job.deadline_expired(now):
+                    self._evict(job)
+                    self._terminal(
+                        job, J.TIMEOUT,
+                        detail={
+                            "detail": f"deadline {job.deadline_s}s expired "
+                                      f"after {int(self._slot_steps(job))} "
+                                      "steps",
+                        },
+                    )
+
+    def _pick_next(self, capacity: int) -> J.Job | None:
+        """Highest priority first; per-client round-robin within a
+        priority tier (a chatty client cannot starve others); accept
+        order last. Only jobs whose trace fits `capacity`."""
+        best = None
+        best_key = None
+        for job_id in self.queue:
+            job = self.jobs[job_id]
+            if job._trace is None or job._trace.max_len > capacity:
+                continue
+            key = (
+                -job.priority,
+                self._last_pick.get(job.client, -1),
+                job.accepted_t,
+            )
+            if best_key is None or key < best_key:
+                best, best_key = job, key
+        return best
+
+    def _fill_slots(self) -> None:
+        """Splice pending jobs into free slots, smallest-fitting bucket
+        first; one deferred `upload_events` per bucket covers the whole
+        batch of splices."""
+        for b in self.buckets:
+            spliced = False
+            while True:
+                i = b.free_slot()
+                if i is None:
+                    break
+                job = self._pick_next(b.capacity)
+                if job is None:
+                    break
+                self.queue.remove(job.job_id)
+                self._pick_n += 1
+                self._last_pick[job.client] = self._pick_n
+                self._place(b, i, job, upload=False)
+                spliced = True
+            if spliced:
+                b.fleet.upload_events()
+
+    def _place(self, b: SlotBucket, i: int, job: J.Job,
+               upload: bool = True) -> None:
+        from ..sim.checkpoint import load_element_checkpoint
+
+        b.fleet.replace_element(
+            i, job._trace, base_cfg=job._elem_cfg, upload=upload
+        )
+        resumed = False
+        if job._resume_from:
+            try:
+                snap = load_element_checkpoint(
+                    job._resume_from, job._elem_cfg, job._trace
+                )
+                b.fleet.restore_element(i, snap)
+                resumed = True
+            except Exception as e:  # corrupt/mismatched ckpt: fresh start
+                self.journal.note(
+                    f"{job.job_id}: element checkpoint unusable "
+                    f"({type(e).__name__}: {e}); restarting from step 0"
+                )
+        b.slots[i] = job
+        job.attempts += 1
+        job.transition(J.RUNNING)
+        self.journal.state(
+            job.job_id, J.RUNNING,
+            detail={"attempt": job.attempts, "resumed": resumed,
+                    "bucket_pages": b.n_pages, "slot": i},
+        )
+
+    def _slot_of(self, job: J.Job) -> tuple[SlotBucket, int] | None:
+        for b in self.buckets:
+            for i, occ in enumerate(b.slots):
+                if occ is job:
+                    return b, i
+        return None
+
+    def _slot_steps(self, job: J.Job) -> int:
+        loc = self._slot_of(job)
+        if loc is None:
+            return 0
+        b, i = loc
+        return int(b.fleet.steps_run[i])
+
+    def _evict(self, job: J.Job) -> None:
+        """Free a RUNNING job's slot without journaling (caller decides
+        the terminal record)."""
+        loc = self._slot_of(job)
+        if loc is not None:
+            b, i = loc
+            b.fleet.clear_element(i)
+            b.slots[i] = None
+
+    def _harvest(self, now: float) -> None:
+        for b in self.buckets:
+            if b.occupied == 0:
+                continue
+            dm = b.fleet.done_mask()
+            cleared = False
+            for i, job in enumerate(b.slots):
+                if job is None:
+                    continue
+                if dm[i]:
+                    result = self._element_result(b, i)
+                    b.fleet.clear_element(i, upload=False)
+                    b.slots[i] = None
+                    cleared = True
+                    self.total_instructions += result["instructions"]
+                    self.completed += 1
+                    self._terminal(job, J.DONE, result=result)
+                    self._drop_ckpt(job.job_id)
+                elif int(b.fleet.steps_run[i]) >= job.max_steps:
+                    steps = int(b.fleet.steps_run[i])
+                    b.fleet.clear_element(i, upload=False)
+                    b.slots[i] = None
+                    cleared = True
+                    self._terminal(
+                        job, J.QUARANTINED,
+                        detail={
+                            "type": "StepBudget",
+                            "location": {},
+                            "detail": f"step budget {job.max_steps} "
+                                      f"exhausted at {steps} steps "
+                                      "(deadlock?)",
+                        },
+                    )
+                    self._drop_ckpt(job.job_id)
+            if cleared:
+                b.fleet.upload_events()
+
+    def _element_result(self, b: SlotBucket, i: int) -> dict:
+        """The job's result record: per-core cycles and counters, exactly
+        what a solo Engine run of (elem_cfg, trace) reports — the
+        bit-exactness contract the tests pin."""
+        cyc = b.fleet.cycles[i]
+        counters = b.fleet.element_counters(i)
+        return {
+            "cycles": int(cyc.max()),
+            "core_cycles": [int(c) for c in cyc],
+            "steps": int(b.fleet.steps_run[i]),
+            "instructions": int(counters["instructions"].sum()),
+            "counters": {
+                k: [int(x) for x in v] for k, v in counters.items()
+            },
+        }
+
+    # ---- failure / retry -------------------------------------------------
+
+    def _dispatch_failed(self, b: SlotBucket, exc: BaseException) -> None:
+        """A chunk dispatch failed. The exception cannot name the guilty
+        element, so the bucket rolls back wholesale: every occupant
+        spends one retry (with backoff + checkpoint resume) or goes
+        FAILED, then the fleet is rebuilt all-idle."""
+        occupants = [j for j in b.slots if j is not None]
+        self.journal.note(
+            f"bucket[{b.n_pages}p] dispatch failed with "
+            f"{type(exc).__name__}: {exc}; rolling back "
+            f"{len(occupants)} occupant(s)"
+        )
+        max_delay = 0.0
+        for job in occupants:
+            delay = job._ctx.next_retry(exc) if job._ctx else None
+            if delay is None:
+                job.transition(J.FAILED, detail=error_obj(exc)["error"])
+                job.detail["retry_log"] = list(job._ctx.log) if job._ctx \
+                    else []
+                self.journal.state(
+                    job.job_id, J.FAILED, detail=job.detail
+                )
+                self._finish_stats(job)
+                self._drop_ckpt(job.job_id)
+            else:
+                max_delay = max(max_delay, delay)
+                job.transition(J.PENDING)
+                if os.path.exists(self.job_ckpt_path(job.job_id)):
+                    job._resume_from = self.job_ckpt_path(job.job_id)
+                self.queue.append(job.job_id)
+                self.journal.state(
+                    job.job_id, J.PENDING,
+                    detail={"detail": "re-enqueued after dispatch failure"},
+                )
+        b.rebuild()
+        self._backoff_until = time.time() + max_delay
+
+    # ---- durability ------------------------------------------------------
+
+    def checkpoint_running(self) -> None:
+        """Element-checkpoint every RUNNING job to its deterministic
+        per-job path (atomic tmp+rename, so a crash mid-save leaves the
+        previous checkpoint intact)."""
+        from ..sim.checkpoint import save_element_checkpoint
+
+        for b in self.buckets:
+            for i, job in enumerate(b.slots):
+                if job is not None:
+                    save_element_checkpoint(
+                        self.job_ckpt_path(job.job_id), b.fleet, i,
+                        job_id=job.job_id,
+                    )
+
+    def _drop_ckpt(self, job_id: str) -> None:
+        try:
+            os.unlink(self.job_ckpt_path(job_id))
+        except OSError:
+            pass
+
+    def drain(self) -> int:
+        """Graceful shutdown: checkpoint every in-flight job so the next
+        server resumes it mid-run, then journal the clean-drain marker.
+        Returns the number of jobs left unfinished (pending+running)."""
+        self.checkpoint_running()
+        unfinished = len(self.queue)
+        for b in self.buckets:
+            for job in b.slots:
+                if job is not None:
+                    unfinished += 1
+        self.journal.drain()
+        return unfinished
+
+    # ---- terminal bookkeeping / stats ------------------------------------
+
+    def _terminal(self, job: J.Job, state: str, detail: dict | None = None,
+                  result: dict | None = None) -> None:
+        job.transition(state, detail=detail)
+        if result is not None:
+            job.result = result
+        self.journal.state(job.job_id, state, detail=detail, result=result)
+        self._finish_stats(job)
+
+    def _finish_stats(self, job: J.Job) -> None:
+        if job.latency_s is not None:
+            self._latencies.append(job.latency_s)
+            if len(self._latencies) > 512:
+                del self._latencies[:-512]
+
+    def stats(self) -> dict:
+        now = time.time()
+        by_state = {s: 0 for s in J.STATES}
+        for job in self.jobs.values():
+            by_state[job.state] += 1
+        lat = sorted(self._latencies)
+
+        def pct(p):
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 3)
+
+        wall = max(now - self.started_t, 1e-9)
+        return {
+            "queue_depth": len(self.queue),
+            "slots": {
+                "total": self.total_slots,
+                "occupied": sum(b.occupied for b in self.buckets),
+                "buckets": [
+                    {
+                        "pages": b.n_pages,
+                        "capacity_events": b.capacity,
+                        "slots": b.n_slots,
+                        "occupied": b.occupied,
+                    }
+                    for b in self.buckets
+                ],
+            },
+            "jobs": by_state,
+            "completed": self.completed,
+            "aggregate_mips": round(
+                self.total_instructions / wall / 1e6, 3
+            ),
+            "latency_s": {"p50": pct(0.50), "p90": pct(0.90),
+                          "p99": pct(0.99)},
+            "uptime_s": round(wall, 1),
+        }
+
+    def service_report(self) -> dict:
+        """The SERVICE section for stats.report.render_report."""
+        s = self.stats()
+        return {
+            "jobs_completed": s["completed"],
+            "jobs_by_state": {k: v for k, v in s["jobs"].items() if v},
+            "aggregate_mips": s["aggregate_mips"],
+            "latency_s": s["latency_s"],
+            "uptime_s": s["uptime_s"],
+        }

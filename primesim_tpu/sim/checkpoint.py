@@ -277,6 +277,11 @@ def load_checkpoint(path: str, engine) -> None:
         raise ValueError(
             f"{path}: fleet checkpoint — resume it with a FleetEngine"
         )
+    if "element" in z:
+        raise ValueError(
+            f"{path}: per-job element checkpoint — splice it into a "
+            "serving fleet (FleetEngine.restore_element)"
+        )
     cfg_json = bytes(z["config_json"]).decode()
     if MachineConfig.from_json(cfg_json) != engine.cfg:
         raise ValueError(f"{path}: checkpoint config does not match engine config")
@@ -302,6 +307,65 @@ def load_checkpoint(path: str, engine) -> None:
     hc = z["host_counters"]
     engine.host_counters = {
         k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
+    }
+
+
+def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "") -> None:
+    """Snapshot ONE fleet element solo-shaped — the serving daemon's
+    per-JOB checkpoint record (DESIGN.md §14). A fleet chunk boundary is
+    a consistent per-element cut (elements are mutually independent), so
+    the saved state can later be spliced into ANY slot of ANY serving
+    fleet on the same geometry (`FleetEngine.restore_element`) and resume
+    bit-exactly — the slot number is not part of the job's identity."""
+    fleet._drain()
+    arrays = _state_arrays(fleet.element_state(i))
+    arrays["host_counters"] = np.stack(
+        [fleet.host_counters[k][i] for k in COUNTER_NAMES]
+    )  # [n_counters, C]
+    atomic_save_npz(
+        path,
+        format=np.int64(_FORMAT),
+        element=np.int64(1),
+        cycle_base=np.int64(fleet.cycle_base[i]),
+        steps_run=np.int64(fleet.steps_run[i]),
+        job_id=np.frombuffer(str(job_id).encode(), dtype=np.uint8),
+        config_json=np.frombuffer(
+            fleet.elem_cfgs[i].to_json().encode(), dtype=np.uint8
+        ),
+        trace_sha=np.frombuffer(
+            trace_fingerprint(fleet.traces[i]).encode(), dtype=np.uint8
+        ),
+        **arrays,
+    )
+
+
+def load_element_checkpoint(path: str, cfg, trace) -> dict:
+    """Load a per-job element checkpoint, validated against the job's
+    effective config + trace (fingerprints, same discipline as the solo
+    loader). Returns the dict `FleetEngine.restore_element` consumes:
+    solo-shaped state, 64-bit cycle base / step count, host counters."""
+    z = load_verified_npz(path)
+    if int(z["format"]) != _FORMAT or "element" not in z:
+        raise ValueError(f"{path}: not a compatible element checkpoint")
+    if MachineConfig.from_json(bytes(z["config_json"]).decode()) != cfg:
+        raise ValueError(f"{path}: checkpoint config does not match job")
+    if bytes(z["trace_sha"]).decode() != trace_fingerprint(trace):
+        raise ValueError(f"{path}: checkpoint trace does not match job")
+    if z["state_counters"].shape[0] != len(COUNTER_NAMES):
+        raise ValueError(
+            f"{path}: checkpoint has {z['state_counters'].shape[0]} counter "
+            f"rows but this build defines {len(COUNTER_NAMES)} — saved by an "
+            "incompatible version"
+        )
+    hc = z["host_counters"]
+    return {
+        "state": _state_from(z),
+        "cycle_base": np.int64(z["cycle_base"]),
+        "steps_run": np.int64(z["steps_run"]),
+        "job_id": bytes(z["job_id"]).decode(),
+        "host_counters": {
+            k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
+        },
     }
 
 

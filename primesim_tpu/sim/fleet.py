@@ -47,6 +47,27 @@ from ..trace.format import EV_BARRIER, EV_END, EV_LOCK, EV_UNLOCK, Trace
 from .engine import _ACC_BITS, _np, run_chunk, run_loop
 from .state import MachineState, init_state
 
+
+def idle_trace(n_cores: int) -> Trace:
+    """The empty workload: every core's trace is a single END event, so
+    the element is done before its first step. Free slots in a serving
+    fleet (serve/scheduler.py) hold this trace — the vmapped step is a
+    no-op for them while live slots advance."""
+    events = np.zeros((n_cores, 1, 4), np.int32)
+    events[:, :, 0] = EV_END
+    return Trace(events, np.ones(n_cores, np.int32))
+
+
+def _trace_per_step_bound(cfg: MachineConfig, trace: Trace) -> int:
+    """Worst-case per-step instruction-counter increment for one trace
+    (the Engine/FleetEngine accumulator-overflow bound)."""
+    per_ev = max(
+        1,
+        int(trace.events[:, :, 1].max(initial=0)),
+        int(trace.events[:, :, 3].max(initial=0)) + 1,
+    )
+    return (cfg.local_run_len + 1) * per_ev
+
 #: Override keys `apply_overrides` accepts — the TimingKnobs fields, named
 #: as a user would write them in a sweep spec, plus `fault_seed` (not a
 #: TimingKnob — it seeds the traced FaultState — but traced all the same,
@@ -175,6 +196,8 @@ class FleetEngine:
         traces: list[Trace],
         overrides: list[dict] | None = None,
         chunk_steps: int = 256,
+        min_events_capacity: int = 0,
+        force_sync: bool = False,
     ):
         if cfg.pallas_reduce:
             raise ValueError(
@@ -218,12 +241,16 @@ class FleetEngine:
                 ((ty == EV_LOCK) | (ty == EV_UNLOCK) | (ty == EV_BARRIER)).any()
             )
         # static specialization is shared: ANY element with sync events
-        # turns phase 2.7 on for the whole fleet (a no-op for the others)
-        self.has_sync = has_sync
+        # turns phase 2.7 on for the whole fleet (a no-op for the others).
+        # `force_sync` pins it True so a serving fleet's compiled program
+        # never depends on which jobs happen to occupy its slots.
+        self.has_sync = has_sync or force_sync
         # events: per-element line-event arrays END-padded to a common T
         # and stacked [B, C, T, 4] (END padding is the format's own
-        # convention — engines clamp ptr to T-1)
-        T = max(t.max_len for t in traces)
+        # convention — engines clamp ptr to T-1). `min_events_capacity`
+        # reserves slack so traces up to that length can be SPLICED in
+        # later (replace_element) without changing the compiled shape.
+        T = max(max(t.max_len for t in traces), int(min_events_capacity))
         evs = []
         for t in traces:
             e = np.asarray(t.line_events(cfg.line_bits))
@@ -242,12 +269,7 @@ class FleetEngine:
         self.chunk_steps = chunk_steps
         # same per-chunk counter-accumulator bound as Engine, over the
         # worst event of ANY element
-        per_ev = max(
-            1,
-            max(int(t.events[:, :, 1].max(initial=0)) for t in traces),
-            max(int(t.events[:, :, 3].max(initial=0)) for t in traces) + 1,
-        )
-        per_step = (cfg.local_run_len + 1) * per_ev
+        per_step = max(_trace_per_step_bound(cfg, t) for t in traces)
         if chunk_steps * per_step >= 1 << _ACC_BITS:
             raise ValueError(
                 f"chunk_steps={chunk_steps} x max per-step instruction "
@@ -443,3 +465,146 @@ class FleetEngine:
         from .checkpoint import load_fleet_checkpoint
 
         load_fleet_checkpoint(path, self)
+
+    # ---- slot splice / retire (continuous batching; serve/) --------------
+
+    @classmethod
+    def make_slots(
+        cls,
+        cfg: MachineConfig,
+        n_slots: int,
+        capacity_events: int,
+        chunk_steps: int = 256,
+    ) -> "FleetEngine":
+        """An all-idle serving fleet: `n_slots` elements holding the empty
+        workload (`idle_trace`), with event storage reserved for traces up
+        to `capacity_events` per core. Jobs are spliced into free slots
+        with `replace_element` and retired with `clear_element`; the
+        compiled program (geometry, [B, C, T] shapes, has_sync=True) never
+        changes across the fleet's whole service lifetime."""
+        return cls(
+            cfg,
+            [idle_trace(cfg.n_cores)] * n_slots,
+            chunk_steps=chunk_steps,
+            min_events_capacity=capacity_events,
+            force_sync=True,
+        )
+
+    @property
+    def events_capacity(self) -> int:
+        """Per-core event-slot capacity (the padded T of the compiled
+        shape) — the longest trace `replace_element` accepts."""
+        return int(self._events_np.shape[2])
+
+    def replace_element(
+        self,
+        i: int,
+        trace: Trace,
+        override: dict | None = None,
+        base_cfg: MachineConfig | None = None,
+        upload: bool = True,
+    ) -> None:
+        """Splice a new (trace, override) workload into batch position `i`
+        without touching any other element: rewrite the element's event
+        row (END-padded to the fleet capacity), reset its machine state to
+        `init_state` of its effective config, and zero its host
+        accumulators. The compiled program is untouched — geometry, shapes
+        and `has_sync` are all static — so admission never recompiles.
+
+        `base_cfg` (default: the fleet's own config) lets a server admit
+        under a RELOADED traced-knob config (e.g. a SIGHUP-refreshed fault
+        schedule); it must normalize to the fleet's geometry key.
+
+        `upload=False` defers the host->device events copy so a batch of
+        splices in one scheduling tick pays for ONE `upload_events()`."""
+        from ..trace.format import validate_sync
+
+        ov = dict(override or {})
+        ecfg = apply_overrides(base_cfg or self.cfg, ov)
+        if ecfg.timing_normalized() != self.geom_cfg:
+            raise ValueError(
+                "replace_element: effective config does not share this "
+                "fleet's compiled geometry"
+            )
+        if trace.n_cores != self.cfg.n_cores:
+            raise ValueError(
+                f"trace has {trace.n_cores} cores, config {self.cfg.n_cores}"
+            )
+        validate_sync(trace, self.cfg.barrier_slots)
+        e = np.asarray(trace.line_events(self.cfg.line_bits))
+        T = self.events_capacity
+        if e.shape[1] > T:
+            raise ValueError(
+                f"trace needs {e.shape[1]} event slots/core but this "
+                f"fleet's capacity is {T}"
+            )
+        per_step = _trace_per_step_bound(self.cfg, trace)
+        if self.chunk_steps * per_step >= 1 << _ACC_BITS:
+            raise ValueError(
+                f"chunk_steps={self.chunk_steps} x max per-step "
+                f"instruction increment {per_step} overflows the "
+                f"2^{_ACC_BITS} per-chunk counter accumulator"
+            )
+        row = np.zeros((self.cfg.n_cores, T, 4), np.int32)
+        row[:, :, 0] = EV_END
+        row[:, : e.shape[1]] = e
+        self._events_np[i] = row
+        self.traces[i] = trace
+        self.elem_cfgs[i] = ecfg
+        self.element_overrides[i] = ov
+        # flush the previous occupant's device counters before its state
+        # row is overwritten (harvest reads host_counters afterwards)
+        self._drain()
+        solo = init_state(ecfg)
+        self.state = jax.tree.map(
+            lambda b, s: b.at[i].set(s), self.state, solo
+        )
+        self.cycle_base[i] = 0
+        self.steps_run[i] = 0
+        for k in self.host_counters:
+            self.host_counters[k][i] = 0
+        if upload:
+            self.upload_events()
+
+    def clear_element(self, i: int, upload: bool = True) -> None:
+        """Retire batch position `i` back to the idle workload (done at
+        step 0): the slot stops contributing work to the vmapped step and
+        is ready for the next `replace_element`."""
+        self.replace_element(i, idle_trace(self.cfg.n_cores), upload=upload)
+
+    def restore_element(self, i: int, snap: dict) -> None:
+        """Load an element checkpoint (checkpoint.load_element_checkpoint)
+        into batch position `i`. Call `replace_element(i, trace, override)`
+        with the SAME workload first — this only overlays the mid-run
+        machine state and 64-bit host accumulators, making the resumed
+        element bit-exact with one that was never interrupted."""
+        self.state = jax.tree.map(
+            lambda b, s: b.at[i].set(jnp.asarray(s)),
+            self.state,
+            snap["state"],
+        )
+        self.cycle_base[i] = snap["cycle_base"]
+        self.steps_run[i] = snap["steps_run"]
+        for k in COUNTER_NAMES:
+            self.host_counters[k][i] = snap["host_counters"][k]
+
+    def upload_events(self) -> None:
+        """Push the host event array (mutated by splices) to the device.
+        One call covers any number of `upload=False` splices."""
+        self.events = jnp.asarray(self._events_np)
+
+    def step_chunk(self) -> None:
+        """Advance the whole batch by exactly ONE committed chunk (the
+        serving tick): dispatch, drain counters, rebase clocks. Finished
+        and idle elements freeze (their steps_run stays put)."""
+        live = ~self.done_mask()
+        self.state = fleet_run_chunk(
+            self.geom_cfg,
+            self.chunk_steps,
+            self.events,
+            self.state,
+            has_sync=self.has_sync,
+        )
+        self.steps_run += np.where(live, self.chunk_steps, 0)
+        self._drain()
+        self._rebase()

@@ -106,6 +106,44 @@ def classify_failure(exc: BaseException) -> str | None:
     return None
 
 
+class JobContext:
+    """Per-JOB supervision context for the serving daemon (serve/):
+    the retry-with-backoff policy RunSupervisor applies per chunk,
+    re-scoped to one job's whole lifetime. The scheduler consults it
+    whenever the job's element fails (batch dispatch error attributed to
+    the job, admission failure, guard violation): `next_retry(exc)`
+    returns the backoff delay in seconds for another attempt, or None
+    when the job must move to a terminal state instead (permanent error,
+    or the retry budget is spent). Attempts and every decision are
+    recorded so the job's journal/terminal record carries the audit
+    trail, mirroring RunSupervisor.log_lines()."""
+
+    def __init__(self, max_retries: int = 2, backoff_s: float = 0.5):
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.attempts = 0
+        self.log: list[str] = []
+
+    def next_retry(self, exc: BaseException) -> float | None:
+        kind = classify_failure(exc)
+        if kind is None:
+            self.log.append(f"permanent: {type(exc).__name__}: {exc}")
+            return None
+        if self.attempts >= self.max_retries:
+            self.log.append(
+                f"give-up: {kind} failure persisted after "
+                f"{self.max_retries} retries: {exc}"
+            )
+            return None
+        self.attempts += 1
+        delay = min(self.backoff_s * (2 ** (self.attempts - 1)), 30.0)
+        self.log.append(
+            f"retry {self.attempts}/{self.max_retries} after {kind} "
+            f"failure ({exc}); backoff {delay:.2f}s"
+        )
+        return delay
+
+
 _SNAP_RE = re.compile(r"ckpt-(\d{8})\.npz")
 
 

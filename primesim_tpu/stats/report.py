@@ -27,6 +27,7 @@ def render_report(
     per_core_limit: int = 64,
     title: str = "primesim_tpu simulation report",
     resilience: list[str] | None = None,
+    service: dict | None = None,
 ) -> str:
     """Render the reference-style text report.
 
@@ -36,7 +37,9 @@ def render_report(
     `resilience` (RunSupervisor.log_lines()) appends a RESILIENCE section
     recording every checkpoint/retry/degradation decision of a supervised
     run — the audit trail the failure-model contract (DESIGN.md §10)
-    promises.
+    promises. `service` (serve Scheduler.service_report()) appends a
+    SERVICE section: jobs by terminal state, aggregate MIPS over the
+    serving window, and accept-to-terminal latency percentiles.
     """
     C = cfg.n_cores
     ins = counters["instructions"].astype(np.int64)
@@ -124,6 +127,19 @@ def render_report(
         add("RESILIENCE")
         for line in resilience:
             add(f"  {line}")
+    if service:
+        add("")
+        add("SERVICE")
+        add(f"  jobs completed      {int(service.get('jobs_completed', 0)):>16,}")
+        for state, n in sorted(service.get("jobs_by_state", {}).items()):
+            add(f"  {state.lower():<19} {int(n):>16,}")
+        add(f"  aggregate MIPS      {float(service.get('aggregate_mips', 0.0)):>16.3f}")
+        lat = service.get("latency_s") or {}
+        for p in ("p50", "p90", "p99"):
+            if lat.get(p) is not None:
+                add(f"  latency {p}         {lat[p]:>16.3f}s")
+        if service.get("uptime_s") is not None:
+            add(f"  uptime seconds      {float(service['uptime_s']):>16.1f}")
     add("=" * 72)
     return "\n".join(lines) + "\n"
 

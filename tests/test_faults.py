@@ -563,7 +563,11 @@ def test_cli_bad_schedule_is_a_clean_error(tmp_path, capsys):
         ]
     )
     assert rc == 2
-    assert "fault config error" in capsys.readouterr().err
+    # typed errors leave the CLI as ONE structured JSON line (serve S2)
+    err_line = capsys.readouterr().err.strip().splitlines()[-1]
+    err = json.loads(err_line)["error"]
+    assert err["type"] == "FaultConfigError"
+    assert "meteor" in err["detail"]
 
 
 def test_cli_faults_reject_streaming_and_golden(tmp_path):

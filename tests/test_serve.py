@@ -1,0 +1,690 @@
+"""Tests for the serving subsystem (serve/): journal durability, the job
+state machine, continuous-batching bit-exactness, deadlines, quarantine,
+backpressure, fairness, crash recovery, the socket protocol, and the CLI
+error/exit-code surface.
+
+Shape discipline: almost every test uses small_test_config(4) with a
+(2 slots x 1 page) bucket and chunk_steps=16 so the whole file shares
+ONE compiled fleet program per process (the serving contract itself).
+
+The subprocess acceptance tests (real `kill -9`, real SIGTERM against a
+real daemon) are @slow: tier-1 pins the semantics in-process; the CI
+serve-smoke job runs the wiring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import small_test_config
+from primesim_tpu.serve import (
+    Job,
+    JobJournal,
+    JournalCorrupt,
+    Scheduler,
+    fold_records,
+)
+from primesim_tpu.serve.scheduler import QueueFull
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SMALL_SYNTH = "fft_like:n_phases=1,points_per_core=8,ins_per_mem=4,seed={}"
+#: 103 events/core: needs 2 pages (>64, <=128), runs for several chunks
+LONG_SYNTH = "fft_like:n_phases=2,points_per_core=24,ins_per_mem=4,seed={}"
+
+
+def _cfg():
+    return small_test_config(4)
+
+
+def _sched(tmp_path, name="srv", buckets=((2, 1),), **kw):
+    d = str(tmp_path / name)
+    kw.setdefault("chunk_steps", 16)
+    kw.setdefault("max_queue", 16)
+    return Scheduler(_cfg(), JobJournal(d), d, buckets=buckets, **kw)
+
+
+def _job(i, synth=SMALL_SYNTH, **kw):
+    return Job(job_id=f"j{i:06d}", synth=synth.format(i), **kw)
+
+
+def _run_all(sched, jobs, limit=5000):
+    n = 0
+    while not all(j.terminal for j in jobs):
+        sched.tick()
+        n += 1
+        assert n < limit, [j.state for j in jobs]
+
+
+def _solo_result(cfg, synth_spec, chunk_steps=16):
+    from primesim_tpu.serve.scheduler import parse_synth_spec
+    from primesim_tpu.sim.engine import Engine
+
+    eng = Engine(cfg, parse_synth_spec(synth_spec, cfg.n_cores, True),
+                 chunk_steps=chunk_steps)
+    eng.run()
+    return (
+        [int(c) for c in eng.cycles],
+        {k: [int(x) for x in v] for k, v in eng.counters.items()},
+    )
+
+
+# ---- journal -------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d)
+    j.accept(_job(1))
+    j.state("j000001", "RUNNING", detail={"attempt": 1})
+    j.state("j000001", "DONE", result={"cycles": 42})
+    j.close()
+
+    j2 = JobJournal(d)
+    recs, dropped = j2.replay()
+    assert dropped == 0
+    assert [r["t"] for r in recs] == ["accept", "state", "state"]
+    jobs, clean = fold_records(recs)
+    assert jobs["j000001"].state == "DONE"
+    assert jobs["j000001"].result == {"cycles": 42}
+    assert not clean
+
+    # a torn TAIL (crash mid-append) is tolerated and reported
+    with open(j2.path, "a") as f:
+        f.write('{"c": 1, "r": {"t": "accept"')  # no newline, no close
+    recs2, dropped2 = JobJournal(d).replay()
+    assert len(recs2) == 3 and dropped2 == 1
+
+
+def test_journal_midfile_corruption_raises(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d)
+    j.note("one")
+    j.note("two")
+    j.close()
+    lines = open(j.path).read().splitlines()
+    lines[0] = lines[0].replace("one", "eno")  # CRC now fails, line 2 valid
+    with open(j.path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(JournalCorrupt):
+        JobJournal(d).replay()
+
+
+def test_journal_ack_is_durable(tmp_path):
+    """accept() returns only after the record is on disk: a reopened
+    journal (no close/flush on the writer) already sees it."""
+    d = str(tmp_path / "wal")
+    j = JobJournal(d)
+    j.accept(_job(7))
+    recs, _ = JobJournal(d).replay()  # writer still open, never closed
+    assert recs and recs[0]["job"]["job_id"] == "j000007"
+
+
+# ---- job state machine ---------------------------------------------------
+
+
+def test_job_state_machine():
+    job = _job(1)
+    job.transition("RUNNING")
+    job.transition("DONE")
+    assert job.terminal and job.latency_s is not None
+    with pytest.raises(ValueError):
+        job.transition("RUNNING")  # terminal states are sticky
+
+    job2 = _job(2)
+    with pytest.raises(ValueError):
+        job2.transition("DONE")  # PENDING cannot skip RUNNING
+    job2.transition("CANCELLED")
+    assert job2.terminal
+
+
+def test_job_accept_record_roundtrip():
+    job = _job(3, deadline_s=9.5, priority=2, client="alice")
+    back = Job.from_accept_record(json.loads(json.dumps(job.accept_record())))
+    assert back.job_id == job.job_id
+    assert back.deadline_s == 9.5
+    assert back.priority == 2
+    assert back.client == "alice"
+    assert back.state == "PENDING"
+
+
+# ---- scheduler: continuous batching, bit-exactness -----------------------
+
+
+def test_scheduler_end_to_end_bit_exact(tmp_path):
+    """More jobs than slots, drained through the continuous-batching
+    loop: every job lands DONE with results identical to a solo Engine
+    run of the same (config, trace) — the serving contract."""
+    sched = _sched(tmp_path)
+    jobs = [_job(i) for i in range(5)]
+    for j in jobs:
+        sched.submit(j)
+    _run_all(sched, jobs)
+    assert all(j.state == "DONE" for j in jobs)
+    for j in jobs:
+        cyc, ctr = _solo_result(sched.cfg, j.synth)
+        assert j.result["core_cycles"] == cyc
+        assert j.result["counters"] == ctr
+    s = sched.stats()
+    assert s["completed"] == 5
+    assert s["queue_depth"] == 0
+    assert s["slots"]["occupied"] == 0
+    assert s["latency_s"]["p50"] is not None
+
+
+def test_scheduler_bucket_routing(tmp_path):
+    """A short trace lands in the small bucket even when the big one is
+    free; a trace too long for page 1 routes to the larger bucket."""
+    sched = _sched(tmp_path, buckets=((2, 1), (1, 2)))
+    small, large = _job(1), _job(2, synth=LONG_SYNTH)
+    sched.submit(small)
+    sched.submit(large)
+    assert large._trace.max_len > sched.buckets[0].capacity  # needs 2 pages
+    sched.tick()
+    assert sched.buckets[0].slots[0] is small
+    assert sched.buckets[1].slots[0] is large
+    _run_all(sched, [small, large])
+    for j in (small, large):
+        assert j.state == "DONE"
+        cyc, ctr = _solo_result(sched.cfg, j.synth)
+        assert j.result["core_cycles"] == cyc
+        assert j.result["counters"] == ctr
+
+
+def test_scheduler_crash_recovery_bit_exact(tmp_path):
+    """Abandon a scheduler mid-flight (the in-process kill -9: no drain,
+    no close), replay its journal into a fresh one, and finish. Every
+    accepted job completes with results identical to an uninterrupted
+    run — including the one resumed from its element checkpoint."""
+    ref = _sched(tmp_path, "ref")
+    refjobs = [_job(i) for i in range(3)]
+    for j in refjobs:
+        ref.submit(j)
+    _run_all(ref, refjobs)
+
+    d = str(tmp_path / "srv")
+    s1 = Scheduler(_cfg(), JobJournal(d), d, buckets=((2, 1),),
+                   chunk_steps=16, max_queue=16, checkpoint_every_s=0.0)
+    jobs1 = [_job(i) for i in range(3)]
+    for j in jobs1:
+        s1.submit(j)
+    for _ in range(3):
+        s1.tick()  # some DONE, some mid-flight with checkpoints on disk
+    del s1  # crash: journal fd dropped, nothing flushed beyond appends
+
+    wal = JobJournal(d)
+    records, dropped = wal.replay()
+    assert dropped == 0
+    jobs, clean = fold_records(records)
+    assert not clean and len(jobs) == 3
+    s2 = Scheduler(_cfg(), wal, d, buckets=((2, 1),),
+                   chunk_steps=16, max_queue=16)
+    for job in jobs.values():
+        (s2.adopt_terminal if job.terminal else s2.requeue_recovered)(job)
+    _run_all(s2, list(s2.jobs.values()))
+    for rj in refjobs:
+        got = s2.jobs[rj.job_id]
+        assert got.state == "DONE"
+        assert got.result["core_cycles"] == rj.result["core_cycles"]
+        assert got.result["counters"] == rj.result["counters"]
+
+
+def test_element_checkpoint_rejected_by_solo_loader(tmp_path):
+    """A per-job element checkpoint must not silently load as a solo-run
+    snapshot (same format version, different shape contract)."""
+    from primesim_tpu.serve.scheduler import parse_synth_spec
+    from primesim_tpu.sim.checkpoint import load_checkpoint
+    from primesim_tpu.sim.engine import Engine
+
+    sched = _sched(tmp_path, buckets=((2, 2),), checkpoint_every_s=0.0)
+    job = Job(job_id="j000001", synth=LONG_SYNTH.format(1))
+    sched.submit(job)
+    sched.tick()
+    ck = sched.job_ckpt_path(job.job_id)
+    assert os.path.exists(ck)
+    eng = Engine(_cfg(), parse_synth_spec(job.synth, 4, True),
+                 chunk_steps=16)
+    with pytest.raises(ValueError, match="element checkpoint"):
+        load_checkpoint(ck, eng)
+
+
+# ---- deadlines, budgets, quarantine, backpressure ------------------------
+
+
+def test_deadline_timeout_in_queue(tmp_path):
+    sched = _sched(tmp_path)
+    job = _job(1, deadline_s=0.0)  # expired at acceptance
+    sched.submit(job)
+    sched.tick()
+    assert job.state == "TIMEOUT"
+    assert "deadline" in job.detail["detail"]
+
+
+def test_deadline_timeout_while_running(tmp_path):
+    sched = _sched(tmp_path, buckets=((2, 2),))
+    job = _job(1, synth=LONG_SYNTH, deadline_s=0.05)
+    sched.submit(job)
+    sched.tick()  # spliced + first chunk
+    time.sleep(0.06)
+    n = 0
+    while not job.terminal:
+        sched.tick()
+        n += 1
+        assert n < 100
+    assert job.state == "TIMEOUT"
+    assert sched.stats()["slots"]["occupied"] == 0  # slot was reclaimed
+
+
+def test_step_budget_quarantines(tmp_path):
+    sched = _sched(tmp_path, buckets=((2, 2),))
+    job = _job(1, synth=LONG_SYNTH, max_steps=16)  # needs far more
+    sched.submit(job)
+    _run_all(sched, [job], limit=100)
+    assert job.state == "QUARANTINED"
+    assert job.detail["type"] == "StepBudget"
+
+
+def test_bad_workload_quarantined_with_structured_error(tmp_path):
+    sched = _sched(tmp_path)
+    bad = Job(job_id="j000001", synth="no_such_generator:x=1")
+    sched.submit(bad)
+    assert bad.state == "QUARANTINED"
+    assert set(bad.detail) >= {"type", "location", "detail"}
+    assert "no_such_generator" in bad.detail["detail"]
+    # the terminal record is journaled even though it never ran
+    jobs, _ = fold_records(sched.journal.replay()[0])
+    assert jobs["j000001"].state == "QUARANTINED"
+
+
+def test_oversized_trace_quarantined(tmp_path):
+    sched = _sched(tmp_path)  # one page = 64 event slots
+    big = _job(1, synth=LONG_SYNTH)
+    sched.submit(big)
+    assert big.state == "QUARANTINED"
+    assert big.detail["type"] == "CapacityError"
+
+
+def test_backpressure_queue_full(tmp_path):
+    sched = _sched(tmp_path, max_queue=2)
+    sched.submit(_job(1))
+    sched.submit(_job(2))
+    with pytest.raises(QueueFull) as ei:
+        sched.submit(_job(3))
+    assert ei.value.retry_after_s > 0
+    # the refused job was never ACKed: nothing about it in the journal
+    jobs, _ = fold_records(sched.journal.replay()[0])
+    assert len(jobs) == 2
+
+
+def test_cancel_pending_and_unknown(tmp_path):
+    sched = _sched(tmp_path)
+    job = _job(1)
+    sched.submit(job)
+    sched.cancel(job.job_id)
+    assert job.state == "CANCELLED"
+    assert job.job_id not in sched.queue
+    with pytest.raises(KeyError):
+        sched.cancel("nope")
+    with pytest.raises(ValueError):
+        sched.cancel(job.job_id)  # already terminal
+
+
+# ---- fairness / priority -------------------------------------------------
+
+
+def _running_order(sched):
+    """job_ids in the order their RUNNING records hit the journal."""
+    recs, _ = sched.journal.replay()
+    return [r["job_id"] for r in recs
+            if r["t"] == "state" and r["state"] == "RUNNING"]
+
+
+def test_per_client_fairness(tmp_path):
+    """One slot, client A floods, client B submits one job later: B runs
+    second, not last — round-robin within the priority tier."""
+    sched = _sched(tmp_path, buckets=((1, 1),))
+    a = [_job(i, client="a") for i in range(3)]
+    b = _job(9, client="b")
+    for j in a:
+        sched.submit(j)
+    sched.submit(b)
+    _run_all(sched, a + [b])
+    order = _running_order(sched)
+    assert order[0] == a[0].job_id  # FIFO among never-picked clients
+    assert order[1] == b.job_id     # b has never been picked: beats a's 2nd
+
+
+def test_priority_beats_accept_order(tmp_path):
+    sched = _sched(tmp_path, buckets=((1, 1),))
+    lo = _job(1, priority=0)
+    hi = _job(2, priority=5)
+    sched.submit(lo)
+    sched.submit(hi)
+    _run_all(sched, [lo, hi])
+    assert _running_order(sched)[0] == hi.job_id
+
+
+# ---- socket server (in-process) ------------------------------------------
+
+
+def test_server_socket_roundtrip(tmp_path):
+    """Full daemon in a worker thread: submit/status/wait/health/cancel
+    over the real unix socket, then the drain verb shuts it down with
+    exit code 0 (queue ran dry)."""
+    import threading
+
+    from primesim_tpu.serve.client import ServeClient, ServeError
+    from primesim_tpu.serve.server import PrimeServer
+
+    server = PrimeServer(
+        _cfg(), state_dir=str(tmp_path / "srv"), buckets=((2, 1),),
+        chunk_steps=16, checkpoint_every_s=60.0,
+    )
+    rc_box = {}
+    t = threading.Thread(
+        target=lambda: rc_box.update(rc=server.serve_forever()), daemon=True
+    )
+    t.start()
+    cli = ServeClient(server.socket_path, timeout_s=60.0)
+    deadline = time.time() + 60
+    while not os.path.exists(server.socket_path):
+        assert time.time() < deadline
+        time.sleep(0.01)
+
+    job = cli.submit(synth=SMALL_SYNTH.format(3), client="t")
+    assert job["job_id"] == "j000001" and job["state"] == "PENDING"
+    done = cli.wait(job["job_id"], timeout_s=120.0)
+    assert done["state"] == "DONE"
+    cyc, ctr = _solo_result(_cfg(), SMALL_SYNTH.format(3))
+    assert done["result"]["core_cycles"] == cyc
+    assert done["result"]["counters"] == ctr
+
+    health = cli.health()
+    assert health["completed"] == 1 and health["queue_depth"] == 0
+
+    with pytest.raises(ServeError, match="unknown job"):
+        cli.status("j999999")
+    with pytest.raises(ServeError):
+        cli.cancel(job["job_id"])  # already terminal
+
+    cli.drain()
+    t.join(timeout=120)
+    assert not t.is_alive()
+    assert rc_box["rc"] == 0  # nothing unfinished at drain
+
+
+def test_server_backpressure_retry_after_on_wire(tmp_path):
+    import threading
+
+    from primesim_tpu.serve.client import ServeClient, ServeError
+    from primesim_tpu.serve.server import PrimeServer
+
+    server = PrimeServer(
+        _cfg(), state_dir=str(tmp_path / "srv"), buckets=((2, 1),),
+        chunk_steps=16, max_queue=1,
+    )
+    # listener + inbox pump only — NO tick loop, so admitted jobs stay
+    # queued and the second submit hits the bound
+    listener = server._make_listener()
+    t = threading.Thread(target=listener.serve_forever, daemon=True)
+    t.start()
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            server._drain_inbox()
+            time.sleep(0.005)
+
+    pumper = threading.Thread(target=pump, daemon=True)
+    pumper.start()
+    try:
+        cli = ServeClient(server.socket_path, timeout_s=30.0)
+        cli.submit(synth=SMALL_SYNTH.format(1))
+        with pytest.raises(ServeError) as ei:
+            cli.submit(synth=SMALL_SYNTH.format(2))
+        assert ei.value.retry_after_s is not None
+        assert ei.value.error["type"] == "QueueFull"
+    finally:
+        stop.set()
+        listener.shutdown()
+        listener.server_close()
+
+
+def test_sighup_reload_rejects_geometry_change(tmp_path):
+    import dataclasses
+
+    from primesim_tpu.serve.server import PrimeServer
+
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        f.write(_cfg().to_json())
+    server = PrimeServer(
+        _cfg(), state_dir=str(tmp_path / "srv"), buckets=((2, 1),),
+        chunk_steps=16, config_path=cfg_path,
+    )
+    # traced-knob change (fault seed): accepted
+    with open(cfg_path, "w") as f:
+        f.write(dataclasses.replace(_cfg(), fault_seed=7).to_json())
+    server.reload_config()
+    assert server.sched.cfg.fault_seed == 7
+    # geometry change: rejected, previous config kept serving
+    with open(cfg_path, "w") as f:
+        f.write(small_test_config(8).to_json())
+    server.reload_config()
+    assert server.sched.cfg.n_cores == 4
+    notes = [r["msg"] for r in server.journal.replay()[0]
+             if r["t"] == "note"]
+    assert any("REJECTED" in m for m in notes)
+    server.journal.close()
+
+
+# ---- report / stats ------------------------------------------------------
+
+
+def test_service_report_section(tmp_path):
+    from primesim_tpu.stats.counters import COUNTER_NAMES
+    from primesim_tpu.stats.report import render_report
+
+    cfg = _cfg()
+    txt = render_report(
+        cfg,
+        {k: np.zeros(cfg.n_cores, np.int64) for k in COUNTER_NAMES},
+        np.zeros(cfg.n_cores, np.int64),
+        title="primetpu serve",
+        service={
+            "jobs_completed": 3,
+            "jobs_by_state": {"DONE": 3, "TIMEOUT": 1},
+            "aggregate_mips": 1.25,
+            "latency_s": {"p50": 0.5, "p90": 1.0, "p99": None},
+            "uptime_s": 12.0,
+        },
+    )
+    assert "SERVICE" in txt
+    assert "jobs completed" in txt and "1.250" in txt
+    assert "timeout" in txt and "latency p90" in txt
+    assert "p99" not in txt  # None percentiles are omitted
+
+
+# ---- CLI: structured errors (S2) + sweep exit code (S1) ------------------
+
+
+def _write_cfg(tmp_path):
+    p = str(tmp_path / "cfg.json")
+    with open(p, "w") as f:
+        f.write(_cfg().to_json())
+    return p
+
+
+def test_cli_run_structured_error_json(tmp_path, capsys):
+    from primesim_tpu.cli import main
+
+    cfg = _write_cfg(tmp_path)
+    bad = str(tmp_path / "bad.ptpu")
+    with open(bad, "wb") as f:
+        f.write(b"definitely not a trace")
+    rc = main(["run", cfg, "--trace", bad])
+    assert rc == 2
+    err_lines = [l for l in capsys.readouterr().err.splitlines()
+                 if l.startswith("{")]
+    assert err_lines, "expected a structured JSON error line on stderr"
+    err = json.loads(err_lines[-1])["error"]
+    assert err["type"] == "TraceError"
+    assert "bad.ptpu" in err["detail"]
+    assert "path" in err["location"]
+
+
+def test_cli_sweep_partial_exits_3(tmp_path, capsys):
+    from primesim_tpu.cli import main
+
+    cfg = _write_cfg(tmp_path)
+    bad = str(tmp_path / "bad.ptpu")
+    with open(bad, "wb") as f:
+        f.write(b"garbage")
+    rc = main(["sweep", cfg, "--trace", bad,
+               "--synth", "false_sharing:n_mem_ops=20",
+               "--chunk-steps", "16"])
+    assert rc == 3  # partial: quarantined element + surviving results
+    out = capsys.readouterr()
+    lines = [json.loads(l) for l in out.out.splitlines()
+             if l.startswith("{")]
+    quar = [l for l in lines if l["metric"] == "quarantined"]
+    assert len(quar) == 1
+    err = quar[0]["detail"]["error"]
+    assert set(err) >= {"type", "location", "detail"}
+    assert "bad.ptpu" in err["detail"]
+    assert [l for l in lines if l["metric"] == "simulated_MIPS"]
+    assert "partial" in out.err
+
+
+def test_cli_submit_requires_running_server(tmp_path, capsys):
+    from primesim_tpu.cli import main
+
+    rc = main(["submit", "--socket", str(tmp_path / "nope.sock"),
+               "--synth", "uniform:n_mem_ops=1"])
+    assert rc == 1
+
+
+# ---- subprocess acceptance: real kill -9 / SIGTERM (CI serve-smoke) ------
+
+
+def _spawn_server(tmp_path, state="state", idle_exit=None, extra=()):
+    from primesim_tpu.serve.client import ServeClient
+
+    cfg_path = _write_cfg(tmp_path)
+    sock = str(tmp_path / state / "serve.sock")
+    if os.path.exists(sock):
+        os.unlink(sock)  # stale socket from a killed predecessor
+    argv = ["serve", cfg_path, "--state-dir", str(tmp_path / state),
+            "--buckets", "2x1,1x4", "--chunk-steps", "16",
+            "--checkpoint-wall", "0.2", *extra]
+    if idle_exit is not None:
+        argv += ["--idle-exit", str(idle_exit)]
+    code = ("import sys; from primesim_tpu.cli import main; "
+            "sys.exit(main(%r))" % (argv,))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    deadline = time.time() + 180
+    probe = ServeClient(sock, timeout_s=5.0)
+    while True:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "server died at startup: "
+                + proc.stderr.read().decode()[-2000:]
+            )
+        if os.path.exists(sock):
+            try:
+                probe.health()
+                break
+            except OSError:
+                pass  # bound but not accepting yet
+        assert time.time() < deadline, "server never became ready"
+        time.sleep(0.1)
+    return proc, sock
+
+
+@pytest.mark.slow
+def test_subprocess_kill9_journal_replay_bit_exact(tmp_path):
+    """kill -9 the daemon mid-batch; restart on the same state dir. Every
+    ACKed job reaches DONE with results identical to solo runs — the
+    accepted-jobs-survive-anything contract, against a real process with
+    real fsyncs."""
+    from primesim_tpu.serve.client import ServeClient
+
+    specs = [SMALL_SYNTH.format(11), SMALL_SYNTH.format(12),
+             "fft_like:n_phases=3,points_per_core=32,ins_per_mem=4,seed=13"]
+    proc, sock = _spawn_server(tmp_path)
+    try:
+        cli = ServeClient(sock, timeout_s=60.0)
+        ids = [cli.submit(synth=s, client="c")["job_id"] for s in specs]
+        # let work start, then kill without any warning
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(j["state"] in ("RUNNING", "DONE")
+                   for j in cli.status()):
+                break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        proc.kill()
+
+    proc2, sock2 = _spawn_server(tmp_path, idle_exit=2.0)
+    try:
+        cli2 = ServeClient(sock2, timeout_s=60.0)
+        results = {i: cli2.wait(i, timeout_s=240.0) for i in ids}
+        out, err = proc2.communicate(timeout=240)
+        assert proc2.returncode == 0, err.decode()[-2000:]
+    finally:
+        proc2.kill()
+    for spec, i in zip(specs, ids):
+        assert results[i]["state"] == "DONE", (i, results[i])
+        cyc, ctr = _solo_result(_cfg(), spec)
+        assert results[i]["result"]["core_cycles"] == cyc
+        assert results[i]["result"]["counters"] == ctr
+
+
+@pytest.mark.slow
+def test_subprocess_sigterm_drains_exit75_then_finishes(tmp_path):
+    """SIGTERM mid-flight: graceful drain checkpoints in-flight jobs and
+    exits 75 (EX_TEMPFAIL); a restarted daemon finishes them bit-exact —
+    the same preemption contract the supervisor gives solo runs."""
+    from primesim_tpu.serve.client import ServeClient
+
+    spec = "fft_like:n_phases=3,points_per_core=32,ins_per_mem=4,seed=21"
+    proc, sock = _spawn_server(tmp_path)
+    try:
+        cli = ServeClient(sock, timeout_s=60.0)
+        job_id = cli.submit(synth=spec)["job_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if cli.status(job_id)["state"] == "RUNNING":
+                break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        proc.kill()
+    if rc == 0:  # the job finished before the signal landed
+        pytest.skip("job completed before SIGTERM; nothing to drain")
+    assert rc == 75, proc.stderr.read().decode()[-2000:]
+
+    proc2, sock2 = _spawn_server(tmp_path, idle_exit=2.0)
+    try:
+        cli2 = ServeClient(sock2, timeout_s=60.0)
+        done = cli2.wait(job_id, timeout_s=240.0)
+        proc2.communicate(timeout=240)
+    finally:
+        proc2.kill()
+    assert done["state"] == "DONE"
+    cyc, ctr = _solo_result(_cfg(), spec)
+    assert done["result"]["core_cycles"] == cyc
+    assert done["result"]["counters"] == ctr

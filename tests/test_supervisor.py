@@ -626,22 +626,32 @@ def test_cli_sweep_quarantines_bad_element(tmp_path, capsys):
     rc = main(["sweep", cfg, "--trace", bad,
                "--synth", "false_sharing:n_mem_ops=20",
                "--chunk-steps", "16"])
-    assert rc == 0  # the batch survives the bad element
+    # the batch survives the bad element, and exit 3 flags the partial
+    # outcome (healthy results emitted, casualties reported)
+    assert rc == 3
     lines = _last_json_lines(capsys)
     quar = [l for l in lines if l["metric"] == "quarantined"]
     assert len(quar) == 1
     assert quar[0]["detail"]["fleet_index"] == 0
     assert quar[0]["detail"]["status"] == "quarantined"
-    assert "bad.ptpu" in quar[0]["detail"]["error"]
+    err = quar[0]["detail"]["error"]  # structured: type/location/detail
+    assert set(err) >= {"type", "location", "detail"}
+    assert "bad.ptpu" in err["detail"]
     agg = [l for l in lines if l["metric"] == "fleet_aggregate_MIPS"]
     assert agg and agg[0]["detail"]["quarantined"] == [0]
     elems = [l for l in lines if l["metric"] == "simulated_MIPS"]
     assert len(elems) == 1 and elems[0]["detail"]["fleet_index"] == 1
 
-    # --strict turns the same input into a hard failure
-    with pytest.raises((SystemExit, ValueError)):
-        main(["sweep", cfg, "--trace", bad,
-              "--synth", "false_sharing:n_mem_ops=20", "--strict"])
+    # --strict turns the same input into a hard failure: exit 2 with one
+    # structured JSON error line on stderr (the typed-error contract)
+    rc = main(["sweep", cfg, "--trace", bad,
+               "--synth", "false_sharing:n_mem_ops=20", "--strict"])
+    assert rc == 2
+    err_lines = [l for l in capsys.readouterr().err.splitlines()
+                 if l.startswith("{")]
+    assert err_lines
+    err = json.loads(err_lines[-1])["error"]
+    assert err["type"] == "TraceError" and "bad.ptpu" in err["detail"]
 
 
 # ---- acceptance: real SIGTERM against a real process ---------------------
