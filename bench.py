@@ -253,6 +253,64 @@ def main() -> None:
             "wall_s": round(wall_srv, 2),
         }
 
+    # telemetry overhead (DESIGN.md §15 overhead contract): wall time of
+    # the chunked engine with the --obs basic metric ring attached vs the
+    # identical chunked dispatch with obs off, on the headline machine
+    # with a shorter trace at chunk 64 (enough chunks that the per-chunk
+    # host hook dominates the comparison, not dispatch noise). Advisory:
+    # recorded + gated at < 3%, never fails the run (host-timer noise on
+    # shared CI runners makes a hard wall-clock gate flaky by design).
+    # PRIMETPU_BENCH_OBS=0 skips (metric and gate report null).
+    obs_detail = None
+    obs_gate = None
+    if os.environ.get("PRIMETPU_BENCH_OBS", "1") != "0":
+        from primesim_tpu.obs import Recorder
+        from primesim_tpu.sim.engine import Engine, run_chunk
+
+        OBS_CHUNK = 64
+        obs_trace = fold_ins(
+            synth.fft_like(
+                C, n_phases=2, points_per_core=64, ins_per_mem=8, seed=42
+            )
+        )
+        warm_o = Engine(cfg, obs_trace, chunk_steps=OBS_CHUNK)
+        out_o = run_chunk(
+            cfg, OBS_CHUNK, warm_o.events, warm_o.state,
+            has_sync=warm_o.has_sync,
+        )
+        np.asarray(out_o.cycles)  # block until compiled
+
+        def _chunked_wall(make_rec, runs: int = 3):
+            best, chunks = None, 0
+            for _ in range(runs):
+                e = Engine(cfg, obs_trace, chunk_steps=OBS_CHUNK)
+                rec = make_rec()
+                if rec is not None:
+                    rec.attach(e)
+                e.block_until_ready()
+                t0 = time.perf_counter()
+                e.run_chunked(max_steps=10_000_000)
+                w = time.perf_counter() - t0
+                best = w if best is None else min(best, w)
+                chunks = e.steps_run // OBS_CHUNK
+            return best, chunks
+
+        wall_off, n_chunks = _chunked_wall(lambda: None)
+        wall_basic, _ = _chunked_wall(lambda: Recorder("basic"))
+        obs_overhead_pct = (wall_basic - wall_off) / wall_off * 100.0
+        obs_detail = {
+            "chunks": int(n_chunks),
+            "chunk_steps": OBS_CHUNK,
+            "wall_s_obs_off": round(wall_off, 4),
+            "wall_s_obs_basic": round(wall_basic, 4),
+            "overhead_pct": round(obs_overhead_pct, 2),
+        }
+        obs_gate = {
+            "floor_pct": 3.0,
+            "hard": False,
+            "passed": bool(obs_overhead_pct < 3.0),
+        }
+
     # LIVE per-phase cuts (scripts/prof/prof_phase.py source surgery) on
     # the headline machine: cumulative ms/step at each phase marker, so
     # every bench artifact carries the serial-chain decomposition next to
@@ -293,6 +351,12 @@ def main() -> None:
                     "simulated_MIPS_1024core_router_dram": (
                         detail_r3["mips"] if detail_r3 else None
                     ),
+                    # --obs basic wall-clock cost over the same chunked
+                    # dispatch with obs off (null when
+                    # PRIMETPU_BENCH_OBS=0; advisory gate < 3%)
+                    "obs_overhead_pct": (
+                        obs_detail["overhead_pct"] if obs_detail else None
+                    ),
                 },
                 "detail": {
                     "n_cores": C,
@@ -315,6 +379,11 @@ def main() -> None:
                     "phase_ms_cuts_measured": phase_ms,
                     "rung3_shipped_config": detail_r3,
                     "rung3_regression_gate": r3_gate,
+                    # telemetry overhead contract (DESIGN.md §15): the
+                    # metric ring at --obs basic vs obs off on the same
+                    # chunked dispatch (null when PRIMETPU_BENCH_OBS=0)
+                    "obs_overhead": obs_detail,
+                    "obs_overhead_gate": obs_gate,
                     # aggregate MIPS batching B sims through one program
                     # (rung-1/64-core config, one distinct trace per
                     # element)

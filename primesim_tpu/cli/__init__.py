@@ -91,7 +91,7 @@ def _load_config(path: str):
 
 def _emit_summary(
     ns, cfg, engine_name, counters, cycles, wall, extra=None,
-    resilience=None,
+    resilience=None, timeline=None,
 ):
     """Shared one-line JSON summary + optional text report (the single
     emission contract for every engine path)."""
@@ -109,6 +109,13 @@ def _emit_summary(
     }
     if extra:
         detail.update(extra)
+    if timeline:
+        detail["timeline"] = {
+            "chunks": timeline["chunks"],
+            "peak_chunk_mips": round(timeline["peak_chunk_mips"], 3),
+            "mean_chunk_mips": round(timeline["mean_chunk_mips"], 3),
+            "slowest_chunk_seq": timeline["slowest_chunk_seq"],
+        }
     print(
         json.dumps(
             {
@@ -123,7 +130,7 @@ def _emit_summary(
         write_report(
             ns.report, cfg, counters, cycles, wall_s=wall,
             per_core_limit=ns.per_core_limit,
-            resilience=resilience,
+            resilience=resilience, timeline=timeline,
         )
         print(f"report written to {ns.report}", file=sys.stderr)
 
@@ -149,7 +156,7 @@ def _check_supervision_flags(ns) -> None:
         )
 
 
-def _build_supervisor(ns, eng):
+def _build_supervisor(ns, eng, obs=None):
     from ..sim.supervisor import RunSupervisor
 
     return RunSupervisor(
@@ -160,6 +167,7 @@ def _build_supervisor(ns, eng):
         checkpoint_every_s=ns.checkpoint_wall,
         guard=ns.guard,
         max_retries=ns.max_retries,
+        obs=obs,
     )
 
 
@@ -184,24 +192,29 @@ def _emit_preempted(e, sup) -> int:
     return 75
 
 
-def _run_supervised(ns, cfg, eng) -> int:
+def _run_supervised(ns, cfg, eng, rec=None) -> int:
     """Supervised `run` path: chunk-committed execution under a
     RunSupervisor (auto-checkpoint, preemption, retry, guard)."""
     from ..sim.supervisor import Preempted
 
-    sup = _build_supervisor(ns, eng)
+    if rec is not None:
+        rec.attach(eng)
+    sup = _build_supervisor(ns, eng, obs=rec)
     if ns.resume:
         sup.resume()
     t0 = time.perf_counter()
     try:
         sup.run(max_steps=ns.max_steps)  # None -> engine-appropriate budget
     except Preempted as e:
+        _finalize_obs(rec)  # the flight recorder survives preemption
         return _emit_preempted(e, sup)
     wall = time.perf_counter() - t0
     _emit_summary(
         ns, cfg, ns.engine, eng.counters, eng.cycles, wall,
         extra=sup.summary(), resilience=sup.log_lines(),
+        timeline=rec.timeline_summary() if rec is not None else None,
     )
+    _finalize_obs(rec)
     return 0
 
 
@@ -262,6 +275,17 @@ def cmd_run(ns) -> int:
             "--xprof/--debug-invariants do not compose with the supervised "
             "path (--guard runs the same invariants post-chunk)"
         )
+    rec = _build_recorder(ns)
+    if rec is not None and ns.engine == "golden":
+        raise SystemExit(
+            "--obs requires --engine jax (the golden oracle has no "
+            "chunk loop to instrument)"
+        )
+    if rec is not None and ns.xprof:
+        raise SystemExit(
+            "--obs does not compose with --xprof (pick the flight "
+            "recorder OR the XLA profiler for a given run)"
+        )
 
     if ns.engine == "golden":
         if (
@@ -296,7 +320,9 @@ def cmd_run(ns) -> int:
         # preloaded path above
         eng.warmup()
         if supervised:
-            return _run_supervised(ns, cfg, eng)
+            return _run_supervised(ns, cfg, eng, rec=rec)
+        if rec is not None:
+            rec.attach(eng)  # streaming always windows; no path change
         t0 = time.perf_counter()
         eng.run(max_steps=ns.max_steps)  # None -> event-count-derived
         wall = time.perf_counter() - t0
@@ -331,7 +357,7 @@ def cmd_run(ns) -> int:
         # path dispatches run_chunk, not the fused run_loop — warm the
         # function the run will actually use.
         warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
-        if ns.debug_invariants or supervised:
+        if ns.debug_invariants or supervised or rec is not None:
             # the chunked paths (debug + supervised run_steps) dispatch
             # run_chunk, not the fused run_loop — warm what will run
             out = run_chunk(
@@ -348,13 +374,17 @@ def cmd_run(ns) -> int:
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
         eng.block_until_ready()  # don't bill async uploads to simulation
         if supervised:
-            return _run_supervised(ns, cfg, eng)
+            return _run_supervised(ns, cfg, eng, rec=rec)
+        if rec is not None:
+            rec.attach(eng)
 
         def _go():
-            if ns.debug_invariants:
+            if ns.debug_invariants or rec is not None:
+                # chunked dispatch: host visibility at every chunk is
+                # what the telemetry (and the invariant checks) need
                 eng.run_chunked(
                     max_steps=ns.max_steps or 10_000_000,
-                    debug_invariants=True,
+                    debug_invariants=ns.debug_invariants,
                 )
             else:
                 eng.run(max_steps=ns.max_steps or 10_000_000)
@@ -369,7 +399,11 @@ def cmd_run(ns) -> int:
         wall = time.perf_counter() - t0
         cycles, counters = eng.cycles, eng.counters
 
-    _emit_summary(ns, cfg, ns.engine, counters, cycles, wall)
+    _emit_summary(
+        ns, cfg, ns.engine, counters, cycles, wall,
+        timeline=rec.timeline_summary() if rec is not None else None,
+    )
+    _finalize_obs(rec)
     return 0
 
 
@@ -508,6 +542,7 @@ def cmd_sweep(ns) -> int:
     from ..sim.supervisor import Preempted, build_fleet_isolated
 
     supervised = _supervised(ns)
+    rec = _build_recorder(ns)
     if ns.strict:
         traces = [s() if callable(s) else s for s in sources]
         fleet = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps)
@@ -550,7 +585,7 @@ def cmd_sweep(ns) -> int:
         cfg, fleet.traces, fleet.element_overrides,
         chunk_steps=ns.chunk_steps,
     )
-    if supervised:
+    if supervised or rec is not None:
         out_st = fleet_run_chunk(
             warm.geom_cfg, warm.chunk_steps, warm.events, warm.state,
             has_sync=warm.has_sync,
@@ -563,15 +598,18 @@ def cmd_sweep(ns) -> int:
         )
         np.asarray(out[0].cycles)
     fleet.block_until_ready()
+    if rec is not None:
+        rec.attach(fleet)
     stalled: list[int] = []
     if supervised:
-        sup = _build_supervisor(ns, fleet)
+        sup = _build_supervisor(ns, fleet, obs=rec)
         if ns.resume:
             sup.resume()
         t0 = time.perf_counter()
         try:
             sup.run(max_steps=ns.max_steps or 10_000_000)
         except Preempted as e:
+            _finalize_obs(rec)
             return _emit_preempted(e, sup)
         wall = time.perf_counter() - t0
         stalled = list(sup.stalled_elements)
@@ -580,7 +618,18 @@ def cmd_sweep(ns) -> int:
     else:
         t0 = time.perf_counter()
         try:
-            fleet.run(max_steps=ns.max_steps or 10_000_000)
+            if rec is not None:
+                # chunked dispatch so every chunk lands in the metric
+                # ring; same stall isolation as the fused path
+                fleet.run_steps(ns.max_steps or 10_000_000)
+                if not fleet.done():
+                    bad = np.flatnonzero(~fleet.done_mask()).tolist()
+                    raise RuntimeError(
+                        f"fleet: max_steps exceeded on element(s) {bad} "
+                        "(deadlock?)"
+                    )
+            else:
+                fleet.run(max_steps=ns.max_steps or 10_000_000)
         except RuntimeError as e:
             # deadlocked/budget-stalled elements are isolated, same as
             # quarantine: report them, keep the finished elements' results
@@ -654,6 +703,20 @@ def cmd_sweep(ns) -> int:
             }
         )
     )
+    if rec is not None:
+        tl = rec.timeline_summary()
+        if tl:
+            print(
+                json.dumps(
+                    {
+                        "metric": "obs_timeline",
+                        "value": tl["chunks"],
+                        "unit": "chunks",
+                        "detail": tl,
+                    }
+                )
+            )
+        _finalize_obs(rec)
     if quarantined or stalled:
         # partial success is a distinct, scriptable outcome: the healthy
         # elements' results are real (exit 0 would hide the casualties,
@@ -708,6 +771,7 @@ def cmd_serve(ns) -> int:
     cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
     from ..serve.server import PrimeServer
 
+    rec = _build_recorder(ns)
     server = PrimeServer(
         cfg,
         state_dir=ns.state_dir,
@@ -718,6 +782,7 @@ def cmd_serve(ns) -> int:
         checkpoint_every_s=ns.checkpoint_wall,
         config_path=ns.config,
         idle_exit_s=ns.idle_exit,
+        obs=rec,
     )
     print(
         f"serve: listening on {server.socket_path} "
@@ -741,8 +806,10 @@ def cmd_serve(ns) -> int:
             np.zeros(cfg.n_cores, np.int64),
             title="primetpu serve",
             service=server.sched.service_report(),
+            timeline=rec.timeline_summary() if rec is not None else None,
         )
         print(f"report written to {ns.report}", file=sys.stderr)
+    _finalize_obs(rec)
     print(
         f"serve: drained rc={rc} "
         f"({json.dumps(server.sched.service_report())})",
@@ -791,8 +858,31 @@ def cmd_submit(ns) -> int:
     return 0
 
 
+def _watch_line(h: dict) -> str:
+    """One live status line from a health reply (serve-status --watch)."""
+    jobs = h.get("jobs", {})
+    slots = h.get("slots", {})
+    lat = h.get("latency_s") or {}
+    age = h.get("last_dispatch_age_s")
+    parts = [
+        time.strftime("%H:%M:%S"),
+        f"q={h.get('queue_depth', 0)}",
+        f"slots={slots.get('occupied', 0)}/{slots.get('total', 0)}",
+        f"run={jobs.get('RUNNING', 0)}",
+        f"done={h.get('completed', 0)}",
+        f"mips={h.get('aggregate_mips', 0.0)}",
+        f"p50={lat.get('p50') if lat.get('p50') is not None else '-'}",
+        f"disp={f'{age}s ago' if age is not None else 'never'}",
+        f"up={h.get('uptime_s', 0)}s",
+    ]
+    if h.get("draining"):
+        parts.append("DRAINING")
+    return "  ".join(parts)
+
+
 def cmd_serve_status(ns) -> int:
-    """Query a running daemon: health (default), --jobs listing, or
+    """Query a running daemon: health (default), --jobs listing,
+    --metrics (Prometheus text), --watch (live one-line refresh), or
     --drain (ask it to finish the queue and exit)."""
     from ..serve.client import ServeClient, ServeError
 
@@ -802,8 +892,20 @@ def cmd_serve_status(ns) -> int:
             print(json.dumps(cli.drain()))
         elif ns.jobs:
             print(json.dumps(cli.status()))
+        elif ns.metrics:
+            sys.stdout.write(cli.metrics())
+        elif ns.watch:
+            n = 0
+            while True:
+                print(_watch_line(cli.health()), flush=True)
+                n += 1
+                if ns.count and n >= ns.count:
+                    break
+                time.sleep(ns.interval)
         else:
             print(json.dumps(cli.health()))
+    except KeyboardInterrupt:
+        return 0
     except ServeError as e:
         print(json.dumps({"ok": False, "error": e.error}))
         return 1
@@ -854,6 +956,67 @@ def _add_resilience_flags(sp) -> None:
         help="retries per chunk on transient device failures (exponential "
              "backoff; OOM halves chunk_steps; last resort: CPU backend)",
     )
+
+
+def _add_obs_flags(sp) -> None:
+    """Shared run/sweep/serve telemetry surface (DESIGN.md §15). `off`
+    keeps the fused dispatch paths and is bit-exact with a build that
+    has no obs layer at all; `basic` adds the per-chunk metric ring;
+    `full` adds the Chrome-trace flight recorder."""
+    sp.add_argument(
+        "--obs", choices=("off", "basic", "full"), default="off",
+        help="telemetry level: off (default; fused dispatch, bit-exact), "
+             "basic (per-chunk metric time-series, chunked dispatch), "
+             "full (basic + flight-recorder timeline)",
+    )
+    sp.add_argument(
+        "--metrics-out", metavar="FILE",
+        help="dump the per-chunk metric series as JSONL at exit "
+             "(needs --obs basic|full)",
+    )
+    sp.add_argument(
+        "--trace-out", metavar="FILE",
+        help="write the Chrome trace-event timeline at exit — load it "
+             "in Perfetto / chrome://tracing (needs --obs full)",
+    )
+    sp.add_argument(
+        "--obs-capacity", type=int, default=4096, metavar="N",
+        help="metric ring-buffer size in chunks; older samples drop "
+             "first (default 4096)",
+    )
+
+
+def _build_recorder(ns):
+    """--obs flags -> obs.Recorder (or None at level off, which is what
+    keeps every engine telemetry branch dead)."""
+    level = getattr(ns, "obs", "off")
+    if getattr(ns, "trace_out", None) and level != "full":
+        raise SystemExit(
+            "--trace-out requires --obs full (the flight recorder only "
+            "runs at full)"
+        )
+    if getattr(ns, "metrics_out", None) and level == "off":
+        raise SystemExit("--metrics-out requires --obs basic|full")
+    if level == "off":
+        return None
+    from ..obs import Recorder
+
+    return Recorder(
+        level,
+        capacity=ns.obs_capacity,
+        trace_path=getattr(ns, "trace_out", None),
+        metrics_path=getattr(ns, "metrics_out", None),
+    )
+
+
+def _finalize_obs(rec) -> None:
+    """Write the recorder's output files (idempotent; runs on the
+    normal, preempted, and drained exit paths alike)."""
+    if rec is None:
+        return
+    for kind, (path, n) in rec.finalize().items():
+        print(f"obs: {kind} written to {path} ({n} records)",
+              file=sys.stderr)
 
 
 def _add_fault_flags(sp) -> None:
@@ -930,6 +1093,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_resilience_flags(r)
     _add_fault_flags(r)
+    _add_obs_flags(r)
     r.set_defaults(fn=cmd_run)
 
     w = sub.add_parser(
@@ -975,6 +1139,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_resilience_flags(w)
     _add_fault_flags(w)
+    _add_obs_flags(w)
     w.set_defaults(fn=cmd_sweep)
 
     c = sub.add_parser(
@@ -1049,6 +1214,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a text report with the SERVICE section at drain",
     )
     _add_fault_flags(v)
+    _add_obs_flags(v)
     v.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser(
@@ -1093,6 +1259,24 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--drain", action="store_true",
         help="ask the daemon to finish its queue and exit",
+    )
+    t.add_argument(
+        "--metrics", action="store_true",
+        help="print the daemon's Prometheus text exposition (the same "
+             "payload the `metrics` protocol verb serves)",
+    )
+    t.add_argument(
+        "--watch", action="store_true",
+        help="poll health and print one live status line per interval "
+             "(queue, occupancy, MIPS, latency p50, last dispatch)",
+    )
+    t.add_argument(
+        "--interval", type=float, default=2.0, metavar="SEC",
+        help="--watch poll interval (default 2.0)",
+    )
+    t.add_argument(
+        "--count", type=int, default=0, metavar="N",
+        help="--watch: stop after N lines (default 0 = forever)",
     )
     t.set_defaults(fn=cmd_serve_status)
     return p

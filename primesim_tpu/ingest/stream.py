@@ -22,6 +22,8 @@ mmapped file.
 
 from __future__ import annotations
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -119,6 +121,10 @@ class StreamEngine:
         self.cycle_base = np.int64(0)
         self.host_counters = zero_counters(cfg.n_cores)
         self.steps_run = 0
+        # telemetry sink (obs.Recorder) — None skips every telemetry
+        # branch in _advance_window
+        self.obs = None
+        self.obs_label = "stream"
 
     def _fill_window(self):
         from ..trace.format import EV_LD, EV_LOCK, EV_ST, EV_UNLOCK
@@ -179,7 +185,9 @@ class StreamEngine:
         is what makes streaming checkpoints possible."""
         cfg = self.cfg
         C = cfg.n_cores
+        t0 = time.perf_counter() if self.obs is not None else 0.0
         buf, exhausted, filled = self._fill_window()
+        t1 = time.perf_counter() if self.obs is not None else 0.0
         st = self.state._replace(ptr=jnp.zeros(C, jnp.int32))
         out = stream_loop(
             cfg,
@@ -190,7 +198,18 @@ class StreamEngine:
             jnp.asarray(min(budget, 2**31 - 1), jnp.int32),
             has_sync=self.has_sync,
         )
+        t2 = time.perf_counter() if self.obs is not None else 0.0
         k_int, consumed, at_end = absorb_stream_outputs(self, out, buf)
+        if self.obs is not None:
+            # one sample per WINDOW (the stream engine's natural chunk);
+            # absorb's host transfer synchronizes, so it includes the
+            # device executing the window
+            t3 = time.perf_counter()
+            self.obs.chunk_committed(
+                self.obs_label, k_int, t3 - t0, self.host_counters,
+                phases={"fill": t1 - t0, "dispatch": t2 - t1,
+                        "absorb": t3 - t2},
+            )
         finished = bool((at_end & exhausted).all())
         if not finished and k_int == 0 and not consumed.any():
             raise RuntimeError(

@@ -1,0 +1,40 @@
+"""Unified telemetry subsystem (DESIGN.md §15).
+
+Three coupled layers, all host-side and all strictly read-only with
+respect to the simulated machine (the device computation is untouched,
+so `--obs off` is bit-exact by construction and `basic`/`full` only add
+host bookkeeping at chunk boundaries the engines already cross):
+
+- **Metric time-series** (`metrics.MetricStore`): a bounded ring buffer
+  of per-chunk samples — counter DELTAS plus wall-clock phase timings —
+  fed by the engine/fleet/stream chunk loops; dumpable as JSONL.
+- **Flight recorder** (`trace.TraceWriter`): Chrome trace-event JSON
+  (loads in Perfetto / chrome://tracing) with B/E spans for sim chunks,
+  instant events for supervisor decisions (checkpoint, retry, preempt,
+  guard, chaos) and serve scheduler events (admit, dispatch, retire,
+  per-job checkpoint, journal fsync) — one correlated timeline across
+  engine, supervisor, and daemon.
+- **Serve metrics surface** (`prom.render_prometheus`): Prometheus
+  text exposition over the scheduler's live stats (queue depth, jobs by
+  state, per-bucket occupancy, latency histogram, journal fsync
+  latency, throughput) — the `metrics` protocol verb and
+  `serve-status --watch` render the same numbers.
+
+`Recorder` is the facade the CLI wires in: one per run, levels
+`off|basic|full` (off = no Recorder at all — engines carry a plain
+`obs = None` attribute and skip every telemetry branch).
+"""
+
+from .metrics import Histogram, MetricStore
+from .prom import render_prometheus
+from .recorder import LEVELS, Recorder
+from .trace import TraceWriter
+
+__all__ = [
+    "Histogram",
+    "LEVELS",
+    "MetricStore",
+    "Recorder",
+    "TraceWriter",
+    "render_prometheus",
+]

@@ -1,0 +1,129 @@
+"""Prometheus text-exposition renderer for the serve daemon.
+
+Pure formatting over the scheduler's live state — no collection happens
+here (the scheduler/journal already maintain the counters and
+histograms), so rendering is safe to call from the tick loop at any
+time. Output follows the text exposition format version 0.0.4:
+``# HELP`` / ``# TYPE`` headers, histograms as cumulative ``_bucket``
+series with an explicit ``+Inf`` bucket plus ``_sum``/``_count``.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "NaN"
+        return repr(v)
+    return str(v)
+
+
+class _Doc:
+    def __init__(self):
+        self.lines = []
+
+    def metric(self, name, mtype, help_text, samples):
+        """samples: list of (labels_dict_or_None, value)."""
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            if labels:
+                lab = ",".join(
+                    f'{k}="{v}"' for k, v in labels.items()
+                )
+                self.lines.append(f"{name}{{{lab}}} {_fmt(value)}")
+            else:
+                self.lines.append(f"{name} {_fmt(value)}")
+
+    def histogram(self, name, help_text, hist):
+        snap = hist.snapshot()
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} histogram")
+        for bound, cum in zip(snap["bounds"], snap["cumulative"]):
+            self.lines.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+        self.lines.append(f'{name}_bucket{{le="+Inf"}} {snap["count"]}')
+        self.lines.append(f"{name}_sum {_fmt(snap['sum'])}")
+        self.lines.append(f"{name}_count {snap['count']}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(sched, journal=None, draining=False,
+                      recovered=None) -> str:
+    """Render the daemon's scrape payload from a live Scheduler (and
+    optionally its JobJournal + the server's recovery/drain state)."""
+    s = sched.stats()
+    d = _Doc()
+
+    d.metric("primetpu_queue_depth", "gauge",
+             "Jobs pending in the bounded admission queue.",
+             [(None, s["queue_depth"])])
+    d.metric("primetpu_slots", "gauge",
+             "Fleet slots by bucket and occupancy.",
+             [({"pages": str(b["pages"]), "state": "occupied"},
+               b["occupied"]) for b in s["slots"]["buckets"]]
+             + [({"pages": str(b["pages"]), "state": "free"},
+                 b["slots"] - b["occupied"])
+                for b in s["slots"]["buckets"]])
+    d.metric("primetpu_slots_total", "gauge",
+             "Total fleet slots across all buckets.",
+             [(None, s["slots"]["total"])])
+    d.metric("primetpu_slots_occupied", "gauge",
+             "Occupied fleet slots across all buckets.",
+             [(None, s["slots"]["occupied"])])
+    d.metric("primetpu_jobs", "gauge",
+             "Jobs in the table by lifecycle state.",
+             [({"state": st}, n) for st, n in sorted(s["jobs"].items())])
+    d.metric("primetpu_jobs_completed_total", "counter",
+             "Jobs retired DONE since daemon start.",
+             [(None, s["completed"])])
+    d.metric("primetpu_instructions_total", "counter",
+             "Simulated instructions retired across all completed jobs.",
+             [(None, sched.total_instructions)])
+    d.metric("primetpu_aggregate_mips", "gauge",
+             "Simulated MIPS aggregated over daemon uptime.",
+             [(None, s["aggregate_mips"])])
+    d.metric("primetpu_uptime_seconds", "gauge",
+             "Seconds since daemon start.",
+             [(None, s["uptime_s"])])
+    d.metric("primetpu_draining", "gauge",
+             "1 while the daemon is draining for shutdown.",
+             [(None, 1 if draining else 0)])
+
+    last_t = getattr(sched, "last_dispatch_t", None)
+    age = (time.time() - last_t) if last_t else float("nan")
+    d.metric("primetpu_last_dispatch_age_seconds", "gauge",
+             "Seconds since a job was last placed into a slot "
+             "(NaN before the first dispatch).",
+             [(None, age)])
+
+    hist = getattr(sched, "latency_hist", None)
+    if hist is not None:
+        d.histogram("primetpu_job_latency_seconds",
+                    "Accept-to-terminal latency of finished jobs.", hist)
+
+    if journal is not None:
+        d.metric("primetpu_journal_appends_total", "counter",
+                 "Journal records fsynced since daemon start.",
+                 [(None, journal.appended)])
+        fsync = getattr(journal, "fsync_hist", None)
+        if fsync is not None:
+            d.histogram("primetpu_journal_fsync_seconds",
+                        "Wall time of each journal write+flush+fsync.",
+                        fsync)
+
+    if recovered:
+        d.metric("primetpu_recovered_jobs", "gauge",
+                 "Jobs recovered from the journal at startup.",
+                 [({"kind": "replayed"},
+                   recovered.get("jobs_replayed", 0)),
+                  ({"kind": "requeued"},
+                   recovered.get("jobs_requeued", 0))])
+
+    return d.render()

@@ -92,5 +92,9 @@ class ServeClient:
     def health(self) -> dict:
         return self._call({"verb": "health"})
 
+    def metrics(self) -> str:
+        """Prometheus text exposition from the daemon's `metrics` verb."""
+        return self._call({"verb": "metrics"})["text"]
+
     def drain(self) -> dict:
         return self._call({"verb": "drain"})

@@ -31,7 +31,10 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import zlib
+
+from ..obs.metrics import Histogram
 
 
 class JournalCorrupt(ValueError):
@@ -81,16 +84,26 @@ class JobJournal:
                 os.close(dfd)
         self._f = open(self.path, "a", encoding="utf-8")
         self.appended = 0
+        # always-on fsync latency histogram (Prometheus `metrics` verb);
+        # obs is an optional Recorder that additionally puts each fsync
+        # on the flight-recorder timeline
+        self.fsync_hist = Histogram()
+        self.obs = None
 
     # ---- write side ------------------------------------------------------
 
     def append(self, rec: dict) -> None:
         """Durably append one record: write + flush + fsync. The caller
         may ACK the fact the record carries only AFTER this returns."""
+        t0 = time.perf_counter()
         self._f.write(_frame(rec) + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())
+        dt = time.perf_counter() - t0
         self.appended += 1
+        self.fsync_hist.observe(dt)
+        if self.obs is not None:
+            self.obs.fsync_event(dt)
 
     def accept(self, job) -> None:
         self.append({"t": "accept", "job": job.accept_record()})

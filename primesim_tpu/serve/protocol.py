@@ -14,7 +14,10 @@ Verbs:
     result  {job_id}                               -> {job} (terminal only)
     wait    {job_id, timeout_s?}                   -> {job} once terminal
     cancel  {job_id}                               -> {job}
-    health  {}                                     -> service stats
+    health  {}                                     -> service stats + journal
+                                                      recovery/fsync info
+    metrics {}                                     -> {text} Prometheus
+                                                      text exposition
     drain   {}                                     -> ack; server checkpoints
                                                       in-flight work and exits
 

@@ -36,6 +36,7 @@ import time
 
 import numpy as np
 
+from ..obs.metrics import Histogram
 from ..sim.fleet import FleetEngine, apply_overrides
 from ..sim.supervisor import JobContext, validate_fleet_element
 from . import jobs as J
@@ -122,16 +123,26 @@ class SlotBucket:
     and contributes nothing to the vmapped step."""
 
     def __init__(self, cfg, n_slots: int, n_pages: int,
-                 chunk_steps: int = 128):
+                 chunk_steps: int = 128, obs=None):
         self.cfg = cfg
         self.n_slots = int(n_slots)
         self.n_pages = int(n_pages)
         self.capacity = int(n_pages) * PAGE_EVENTS
         self.chunk_steps = int(chunk_steps)
-        self.fleet = FleetEngine.make_slots(
-            cfg, self.n_slots, self.capacity, chunk_steps=self.chunk_steps
-        )
+        self.obs = obs
+        self.fleet = self._make_fleet()
         self.slots: list[J.Job | None] = [None] * self.n_slots
+
+    def _make_fleet(self):
+        fleet = FleetEngine.make_slots(
+            self.cfg, self.n_slots, self.capacity,
+            chunk_steps=self.chunk_steps,
+        )
+        if self.obs is not None:
+            # per-bucket timeline row: the recorder keys counter deltas
+            # by label, so each bucket diffs against its own history
+            self.obs.attach(fleet, label=f"bucket{self.n_pages}p")
+        return fleet
 
     def free_slot(self) -> int | None:
         for i, occ in enumerate(self.slots):
@@ -157,10 +168,7 @@ class SlotBucket:
         poisoned) device state away and start an all-idle fleet on the
         same compiled geometry. Occupants must be re-enqueued by the
         caller BEFORE this runs."""
-        self.fleet = FleetEngine.make_slots(
-            self.cfg, self.n_slots, self.capacity,
-            chunk_steps=self.chunk_steps,
-        )
+        self.fleet = self._make_fleet()
         self.slots = [None] * self.n_slots
 
 
@@ -180,14 +188,16 @@ class Scheduler:
         max_queue: int = 64,
         checkpoint_every_s: float = 2.0,
         max_retries: int = 2,
+        obs=None,
     ):
         self.cfg = cfg
         self.journal = journal
+        self.obs = obs
         self.state_dir = str(state_dir)
         self.jobs_dir = os.path.join(self.state_dir, "jobs")
         os.makedirs(self.jobs_dir, exist_ok=True)
         self.buckets = [
-            SlotBucket(cfg, n, p, chunk_steps=chunk_steps)
+            SlotBucket(cfg, n, p, chunk_steps=chunk_steps, obs=obs)
             for n, p in sorted(buckets, key=lambda b: b[1])
         ]
         self.max_queue = int(max_queue)
@@ -204,6 +214,14 @@ class Scheduler:
         self.total_instructions = 0
         self.completed = 0
         self._latencies: list[float] = []  # terminal latencies, capped
+        # always-on accept-to-terminal latency histogram (the Prometheus
+        # surface) + last-dispatch stamp (health/metrics liveness signal)
+        self.latency_hist = Histogram()
+        self.last_dispatch_t: float | None = None
+
+    def _serve_event(self, kind: str, **args) -> None:
+        if self.obs is not None:
+            self.obs.serve_event(kind, args)
 
     # ---- identity / paths ------------------------------------------------
 
@@ -234,6 +252,8 @@ class Scheduler:
             )
         self.jobs[job.job_id] = job
         self.journal.accept(job)
+        self._serve_event("admit", job_id=job.job_id, client=job.client,
+                          priority=job.priority)
         self._validate_or_quarantine(job)
         if not job.terminal:
             self.queue.append(job.job_id)
@@ -401,11 +421,15 @@ class Scheduler:
         b.slots[i] = job
         job.attempts += 1
         job.transition(J.RUNNING)
+        self.last_dispatch_t = time.time()
         self.journal.state(
             job.job_id, J.RUNNING,
             detail={"attempt": job.attempts, "resumed": resumed,
                     "bucket_pages": b.n_pages, "slot": i},
         )
+        self._serve_event("dispatch", job_id=job.job_id, slot=i,
+                          bucket_pages=b.n_pages, attempt=job.attempts,
+                          resumed=resumed)
 
     def _slot_of(self, job: J.Job) -> tuple[SlotBucket, int] | None:
         for b in self.buckets:
@@ -447,6 +471,10 @@ class Scheduler:
                     self.total_instructions += result["instructions"]
                     self.completed += 1
                     self._terminal(job, J.DONE, result=result)
+                    self._serve_event("retire", job_id=job.job_id,
+                                      state=J.DONE,
+                                      steps=result["steps"],
+                                      instructions=result["instructions"])
                     self._drop_ckpt(job.job_id)
                 elif int(b.fleet.steps_run[i]) >= job.max_steps:
                     steps = int(b.fleet.steps_run[i])
@@ -463,6 +491,8 @@ class Scheduler:
                                       "(deadlock?)",
                         },
                     )
+                    self._serve_event("retire", job_id=job.job_id,
+                                      state=J.QUARANTINED, steps=steps)
                     self._drop_ckpt(job.job_id)
             if cleared:
                 b.fleet.upload_events()
@@ -491,6 +521,9 @@ class Scheduler:
         spends one retry (with backoff + checkpoint resume) or goes
         FAILED, then the fleet is rebuilt all-idle."""
         occupants = [j for j in b.slots if j is not None]
+        self._serve_event("rollback", bucket_pages=b.n_pages,
+                          error=type(exc).__name__,
+                          occupants=len(occupants))
         self.journal.note(
             f"bucket[{b.n_pages}p] dispatch failed with "
             f"{type(exc).__name__}: {exc}; rolling back "
@@ -536,6 +569,10 @@ class Scheduler:
                         self.job_ckpt_path(job.job_id), b.fleet, i,
                         job_id=job.job_id,
                     )
+                    self._serve_event(
+                        "checkpoint", job_id=job.job_id,
+                        steps=int(b.fleet.steps_run[i]),
+                    )
 
     def _drop_ckpt(self, job_id: str) -> None:
         try:
@@ -569,6 +606,7 @@ class Scheduler:
     def _finish_stats(self, job: J.Job) -> None:
         if job.latency_s is not None:
             self._latencies.append(job.latency_s)
+            self.latency_hist.observe(job.latency_s)
             if len(self._latencies) > 512:
                 del self._latencies[:-512]
 
@@ -608,6 +646,11 @@ class Scheduler:
             "latency_s": {"p50": pct(0.50), "p90": pct(0.90),
                           "p99": pct(0.99)},
             "uptime_s": round(wall, 1),
+            "last_dispatch_t": self.last_dispatch_t,
+            "last_dispatch_age_s": (
+                round(now - self.last_dispatch_t, 1)
+                if self.last_dispatch_t else None
+            ),
         }
 
     def service_report(self) -> dict:

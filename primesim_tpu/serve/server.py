@@ -63,6 +63,7 @@ class PrimeServer:
         checkpoint_every_s: float = 2.0,
         config_path: str | None = None,
         idle_exit_s: float | None = None,
+        obs=None,
     ):
         self.state_dir = str(state_dir)
         os.makedirs(self.state_dir, exist_ok=True)
@@ -71,7 +72,9 @@ class PrimeServer:
         )
         self.config_path = config_path
         self.idle_exit_s = idle_exit_s
+        self.obs = obs
         self.journal = JobJournal(self.state_dir)
+        self.journal.obs = obs
         self.sched = Scheduler(
             cfg,
             self.journal,
@@ -80,6 +83,7 @@ class PrimeServer:
             chunk_steps=chunk_steps,
             max_queue=max_queue,
             checkpoint_every_s=checkpoint_every_s,
+            obs=obs,
         )
         self.inbox: "queue.Queue[_Request]" = queue.Queue()
         self._draining = False
@@ -135,6 +139,8 @@ class PrimeServer:
                 return {"ok": True, "job": job.public()}
             if verb == "health":
                 return self._h_health()
+            if verb == "metrics":
+                return self._h_metrics()
             if verb == "drain":
                 self._draining = True
                 return {"ok": True, "draining": True}
@@ -196,7 +202,25 @@ class PrimeServer:
         out = {"ok": True, "draining": self._draining}
         out.update(self.sched.stats())
         out["recovered"] = self.recovered
+        out["journal"] = {
+            "appends": self.journal.appended,
+            "fsync_count": self.journal.fsync_hist.count,
+            "fsync_total_s": round(self.journal.fsync_hist.sum, 6),
+        }
         return out
+
+    def _h_metrics(self) -> dict:
+        """Prometheus text exposition of the live scheduler/journal
+        state — scrape with `primetpu serve-status --metrics` or any
+        client speaking the line protocol."""
+        from ..obs.prom import render_prometheus
+
+        text = render_prometheus(
+            self.sched, journal=self.journal,
+            draining=self._draining, recovered=self.recovered,
+        )
+        return {"ok": True, "content_type":
+                "text/plain; version=0.0.4", "text": text}
 
     # ---- signals ---------------------------------------------------------
 

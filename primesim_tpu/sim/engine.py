@@ -29,6 +29,7 @@ bandwidth ... is the wall-clock make-or-break".
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -2005,6 +2006,11 @@ class Engine:
         self.cycle_base = np.int64(0)
         self.host_counters = zero_counters(cfg.n_cores)
         self.steps_run = 0
+        # telemetry sink (obs.Recorder) — None means every telemetry
+        # branch in the chunked loops is skipped; the fused run() never
+        # consults it at all (DESIGN.md §15 overhead contract)
+        self.obs = None
+        self.obs_label = "engine"
 
     def _drain(self) -> None:
         cnt = _np(self.state.counters)
@@ -2128,13 +2134,35 @@ class Engine:
         run() is bit-exact with an uninterrupted run()."""
         target = self.steps_run + n_steps
         while self.steps_run < target and not self.done():
-            self.state = run_chunk(
-                self.cfg, self.chunk_steps, self.events, self.state,
-                has_sync=self.has_sync,
-            )
-            self.steps_run += self.chunk_steps
-            self._drain()
-            self._rebase()
+            if self.obs is None:
+                self.state = run_chunk(
+                    self.cfg, self.chunk_steps, self.events, self.state,
+                    has_sync=self.has_sync,
+                )
+                self.steps_run += self.chunk_steps
+                self._drain()
+                self._rebase()
+            else:
+                # phase cuts: dispatch is the async enqueue; drain's
+                # host transfer synchronizes, so "drain" includes the
+                # device executing the chunk; rebase is pure host work
+                t0 = time.perf_counter()
+                self.state = run_chunk(
+                    self.cfg, self.chunk_steps, self.events, self.state,
+                    has_sync=self.has_sync,
+                )
+                t1 = time.perf_counter()
+                self.steps_run += self.chunk_steps
+                self._drain()
+                t2 = time.perf_counter()
+                self._rebase()
+                t3 = time.perf_counter()
+                self.obs.chunk_committed(
+                    self.obs_label, self.chunk_steps, t3 - t0,
+                    self.host_counters,
+                    phases={"dispatch": t1 - t0, "drain": t2 - t1,
+                            "rebase": t3 - t2},
+                )
             if debug_invariants:
                 self.verify_invariants()
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -287,6 +288,10 @@ class FleetEngine:
         # this after quarantining elements so reports keep caller indices
         self.element_ids = list(range(B))
         self.element_overrides = [dict(ov) for ov in overrides]
+        # telemetry sink (obs.Recorder) — None skips every telemetry
+        # branch in the chunked loops; fleet_run_loop never consults it
+        self.obs = None
+        self.obs_label = "fleet"
 
     # ---- batched bookkeeping (Engine's host helpers, vectorized) ---------
 
@@ -414,7 +419,13 @@ class FleetEngine:
         ahead of a solo engine's."""
         target = int(self.steps_run.max()) + n_steps
         while int(self.steps_run.max()) < target and not self.done():
-            live = ~self.done_mask()
+            self._chunk_once()
+
+    def _chunk_once(self) -> None:
+        """One committed chunk: dispatch, drain counters, rebase clocks
+        (shared by run_steps and the serving tick's step_chunk)."""
+        live = ~self.done_mask()
+        if self.obs is None:
             self.state = fleet_run_chunk(
                 self.geom_cfg,
                 self.chunk_steps,
@@ -425,6 +436,29 @@ class FleetEngine:
             self.steps_run += np.where(live, self.chunk_steps, 0)
             self._drain()
             self._rebase()
+            return
+        # phase cuts mirror Engine.run_steps: dispatch = async enqueue,
+        # drain = synchronizing transfer (includes device execution),
+        # rebase = host clock bookkeeping
+        t0 = time.perf_counter()
+        self.state = fleet_run_chunk(
+            self.geom_cfg,
+            self.chunk_steps,
+            self.events,
+            self.state,
+            has_sync=self.has_sync,
+        )
+        t1 = time.perf_counter()
+        self.steps_run += np.where(live, self.chunk_steps, 0)
+        self._drain()
+        t2 = time.perf_counter()
+        self._rebase()
+        t3 = time.perf_counter()
+        self.obs.chunk_committed(
+            self.obs_label, self.chunk_steps, t3 - t0, self.host_counters,
+            phases={"dispatch": t1 - t0, "drain": t2 - t1,
+                    "rebase": t3 - t2},
+        )
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.events)
@@ -597,14 +631,4 @@ class FleetEngine:
         """Advance the whole batch by exactly ONE committed chunk (the
         serving tick): dispatch, drain counters, rebase clocks. Finished
         and idle elements freeze (their steps_run stays put)."""
-        live = ~self.done_mask()
-        self.state = fleet_run_chunk(
-            self.geom_cfg,
-            self.chunk_steps,
-            self.events,
-            self.state,
-            has_sync=self.has_sync,
-        )
-        self.steps_run += np.where(live, self.chunk_steps, 0)
-        self._drain()
-        self._rebase()
+        self._chunk_once()

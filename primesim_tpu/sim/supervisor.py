@@ -211,6 +211,7 @@ class RunSupervisor:
         backoff_s: float = 0.5,
         handle_signals: bool = True,
         on_chunk=None,
+        obs=None,
     ):
         if guard not in ("off", "warn", "fail"):
             raise ValueError(f"guard must be off|warn|fail, got {guard!r}")
@@ -232,6 +233,10 @@ class RunSupervisor:
         self.backoff_s = float(backoff_s)
         self.handle_signals = handle_signals
         self.on_chunk = on_chunk
+        # telemetry sink (obs.Recorder) — every supervision event that
+        # lands in the RESILIENCE audit trail is mirrored onto the
+        # flight recorder's "supervisor" timeline row
+        self.obs = obs
         self.committed = 0  # chunks committed under this supervisor
         self.retries = 0
         self.guard_warnings = 0
@@ -256,6 +261,8 @@ class RunSupervisor:
 
     def _log(self, kind: str, msg: str) -> None:
         self._events_log.append((time.monotonic() - self._t0, kind, msg))
+        if self.obs is not None:
+            self.obs.supervisor_event(kind, msg)
 
     def log_lines(self) -> list[str]:
         """Human-readable supervision log (rendered into the report)."""

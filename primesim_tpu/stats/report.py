@@ -28,6 +28,7 @@ def render_report(
     title: str = "primesim_tpu simulation report",
     resilience: list[str] | None = None,
     service: dict | None = None,
+    timeline: dict | None = None,
 ) -> str:
     """Render the reference-style text report.
 
@@ -40,6 +41,9 @@ def render_report(
     promises. `service` (serve Scheduler.service_report()) appends a
     SERVICE section: jobs by terminal state, aggregate MIPS over the
     serving window, and accept-to-terminal latency percentiles.
+    `timeline` (obs.MetricStore.summary(), present only when `--obs` is
+    on) appends a TIMELINE section: per-chunk throughput extremes and
+    the slowest chunk's index in the run.
     """
     C = cfg.n_cores
     ins = counters["instructions"].astype(np.int64)
@@ -122,6 +126,19 @@ def render_report(
         dead = np.flatnonzero(counters["core_failstops"])
         if dead.size:
             add(f"  dead cores          {', '.join(map(str, dead.tolist()))}")
+    if timeline:
+        add("")
+        add("TIMELINE")
+        add(f"  chunks committed    {int(timeline.get('chunks', 0)):>16,}")
+        if timeline.get("dropped"):
+            add(f"  samples dropped     {int(timeline['dropped']):>16,}")
+        add(f"  peak chunk MIPS     {float(timeline.get('peak_chunk_mips', 0.0)):>16.3f}")
+        add(f"  mean chunk MIPS     {float(timeline.get('mean_chunk_mips', 0.0)):>16.3f}")
+        if timeline.get("slowest_chunk_seq", -1) >= 0:
+            add(
+                f"  slowest chunk       {int(timeline['slowest_chunk_seq']):>16,}"
+                f"  ({float(timeline.get('slowest_chunk_wall_s', 0.0)) * 1e3:.1f} ms)"
+            )
     if resilience:
         add("")
         add("RESILIENCE")

@@ -1,0 +1,367 @@
+"""Tests for the telemetry subsystem (obs/, DESIGN.md §15): the metric
+ring buffer, the Chrome trace-event flight recorder (schema-validated:
+required fields, per-tid monotonic timestamps, balanced B/E spans),
+obs-off/obs-on bit-exactness against the fused engine paths, supervisor
+event mirroring, the serve `metrics` verb's Prometheus text, the
+enriched `health` verb, and the report TIMELINE section.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import small_test_config
+from primesim_tpu.obs import Histogram, MetricStore, Recorder, TraceWriter
+from primesim_tpu.obs.prom import render_prometheus
+from primesim_tpu.serve import Job, JobJournal, Scheduler
+from primesim_tpu.serve.scheduler import parse_synth_spec
+from primesim_tpu.sim.engine import Engine
+
+SMALL_SYNTH = "fft_like:n_phases=1,points_per_core=8,ins_per_mem=4,seed={}"
+
+
+def _cfg():
+    return small_test_config(4)
+
+
+def _trace(seed=1):
+    return parse_synth_spec(SMALL_SYNTH.format(seed), 4, True)
+
+
+# ---- MetricStore ---------------------------------------------------------
+
+
+def test_metric_store_ring_and_deltas():
+    st = MetricStore(capacity=3)
+    for i in range(5):
+        st.record(100.0 + i, "engine", 16, 0.01 * (i + 1),
+                  {"instructions": 10 * (i + 1)})
+    assert len(st) == 3
+    assert st.seq == 5
+    assert st.dropped == 2
+    # ring keeps the NEWEST samples, seq keeps counting globally
+    assert [s["seq"] for s in st.samples()] == [2, 3, 4]
+    assert st.samples()[-1]["deltas"]["instructions"] == 50
+
+
+def test_metric_store_summary():
+    st = MetricStore()
+    st.record(0.0, "engine", 16, 0.001, {"instructions": 1000})  # 1.0 MIPS
+    st.record(0.0, "engine", 16, 0.004, {"instructions": 1000})  # 0.25
+    s = st.summary()
+    assert s["chunks"] == 2
+    assert s["peak_chunk_seq"] == 0
+    assert s["peak_chunk_mips"] == pytest.approx(1.0)
+    assert s["slowest_chunk_seq"] == 1
+    assert s["slowest_chunk_wall_s"] == pytest.approx(0.004)
+    # mean = total ins / total wall
+    assert s["mean_chunk_mips"] == pytest.approx(2000 / 0.005 / 1e6)
+    assert MetricStore().summary() is None
+
+
+def test_metric_store_jsonl_roundtrip(tmp_path):
+    st = MetricStore()
+    st.record(1.5, "engine", 16, 0.01, {"instructions": 42},
+              phases={"drain": 0.008})
+    p = str(tmp_path / "m.jsonl")
+    assert st.dump_jsonl(p) == 1
+    lines = [json.loads(ln) for ln in open(p)]
+    assert lines[0]["deltas"]["instructions"] == 42
+    assert lines[0]["phases"]["drain"] == pytest.approx(0.008)
+
+
+def test_histogram_cumulative_shape():
+    h = Histogram(bounds=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["cumulative"] == [1, 3, 4]  # <=0.1, <=1, <=10
+    assert snap["count"] == 5  # +Inf bucket covers the 50.0
+    assert snap["sum"] == pytest.approx(56.05)
+    with pytest.raises(ValueError):
+        Histogram(bounds=(1.0, 1.0))
+
+
+# ---- trace-event schema --------------------------------------------------
+
+
+def _validate_trace(events):
+    """The schema contract: required fields on every event, per-tid
+    non-decreasing ts, balanced + alternating B/E per tid."""
+    assert events, "trace must not be empty"
+    last_ts: dict = {}
+    open_spans: dict = {}
+    for ev in events:
+        for field in ("ph", "ts", "pid", "tid", "name"):
+            assert field in ev, f"missing {field!r} in {ev}"
+        assert ev["ph"] in ("B", "E", "X", "i", "M"), ev
+        tid = ev["tid"]
+        if ev["ph"] == "M":
+            continue
+        assert ev["ts"] >= last_ts.get(tid, 0), (
+            f"ts went backwards on tid {tid}: {ev}"
+        )
+        last_ts[tid] = ev["ts"]
+        if ev["ph"] == "B":
+            assert tid not in open_spans, f"nested B on tid {tid}"
+            open_spans[tid] = ev["name"]
+        elif ev["ph"] == "E":
+            assert open_spans.pop(tid, None) == ev["name"], (
+                f"unbalanced E on tid {tid}: {ev}"
+            )
+    assert not open_spans, f"unclosed spans: {open_spans}"
+
+
+def test_trace_writer_schema():
+    tw = TraceWriter()
+    tw.complete("engine", "chunk", 0.01, {"steps": 16})
+    tw.instant("supervisor", "checkpoint", {"msg": "ckpt-1"})
+    tw.complete("engine", "chunk", 0.02)
+    tw.complete("journal", "fsync", 0.001)
+    _validate_trace(tw.events)
+    names = {e["args"]["name"] for e in tw.events if e["ph"] == "M"}
+    assert names == {"engine", "supervisor", "journal"}
+
+
+def test_trace_writer_clamps_overlapping_spans():
+    tw = TraceWriter()
+    # a duration far longer than the writer has been alive would start
+    # at negative ts; the clamp keeps it at >= 0 and monotonic
+    tw.complete("engine", "chunk", 1e6)
+    tw.complete("engine", "chunk", 1e6)
+    _validate_trace(tw.events)
+    assert all(e["ts"] >= 0 for e in tw.events)
+
+
+def test_trace_writer_file(tmp_path):
+    tw = TraceWriter()
+    tw.complete("engine", "chunk", 0.01)
+    p = str(tmp_path / "t.json")
+    tw.write(p)
+    doc = json.load(open(p))
+    assert "traceEvents" in doc
+    _validate_trace(doc["traceEvents"])
+
+
+def test_trace_writer_drop_bound():
+    tw = TraceWriter(max_events=3)  # metadata + one B/E pair fills it
+    tw.complete("engine", "chunk", 0.01)
+    tw.complete("engine", "chunk", 0.01)  # dropped pairwise
+    tw.instant("engine", "x")  # dropped
+    assert tw.dropped == 3
+    _validate_trace(tw.events)
+
+
+# ---- recorder + engine bit-exactness -------------------------------------
+
+
+def test_obs_on_bit_exact_vs_fused():
+    """The telemetry contract: a recorded chunked run retires exactly
+    what the fused run() retires; `--obs off` IS the fused path (the
+    engine's obs attribute defaults to None)."""
+    cfg, tr = _cfg(), _trace()
+    ref = Engine(cfg, tr, chunk_steps=16)
+    assert ref.obs is None  # off = no recorder anywhere near the engine
+    ref.run()
+
+    rec = Recorder("full")
+    eng = Engine(cfg, tr, chunk_steps=16)
+    rec.attach(eng)
+    eng.run_chunked()
+
+    assert np.array_equal(ref.cycles, eng.cycles)
+    for k in ref.counters:
+        assert np.array_equal(ref.counters[k], eng.counters[k]), k
+    # every committed chunk landed in the ring, deltas sum to the totals
+    s = rec.store.summary()
+    assert s["chunks"] == len(rec.store)
+    assert s["total_instructions"] == int(
+        ref.counters["instructions"].sum()
+    )
+    _validate_trace(rec.trace.events)
+    spans = [e for e in rec.trace.events if e["ph"] == "B"]
+    assert len(spans) == s["chunks"]
+    assert all("dispatch_ms" in e["args"] for e in spans)
+
+
+def test_recorder_levels_and_finalize(tmp_path):
+    with pytest.raises(ValueError):
+        Recorder("verbose")
+    basic = Recorder("basic")
+    assert basic.enabled and not basic.tracing and basic.trace is None
+    basic.supervisor_event("checkpoint", "noop at basic")  # must not throw
+
+    mp, tp = str(tmp_path / "m.jsonl"), str(tmp_path / "t.json")
+    rec = Recorder("full", metrics_path=mp, trace_path=tp)
+    cfg, tr = _cfg(), _trace()
+    eng = Engine(cfg, tr, chunk_steps=16)
+    rec.attach(eng)
+    eng.run_chunked()
+    written = rec.finalize()
+    assert written["metrics"][0] == mp and written["trace"][0] == tp
+    assert rec.finalize() is written  # idempotent
+    _validate_trace(json.load(open(tp))["traceEvents"])
+    assert all(json.loads(ln)["label"] == "engine" for ln in open(mp))
+
+
+def test_supervisor_events_reach_trace(tmp_path):
+    from primesim_tpu.sim.supervisor import RunSupervisor
+
+    rec = Recorder("full")
+    eng = Engine(_cfg(), _trace(), chunk_steps=16)
+    rec.attach(eng)
+    sup = RunSupervisor(
+        eng, snapshot_dir=str(tmp_path / "snap"),
+        checkpoint_every_chunks=1, handle_signals=False, obs=rec,
+    )
+    sup.run()
+    assert sup.checkpoints_written >= 1
+    sup_events = [
+        e for e in rec.trace.events
+        if e["ph"] == "i" and e.get("args", {}).get("msg")
+    ]
+    kinds = {e["name"] for e in sup_events}
+    assert "checkpoint" in kinds
+    _validate_trace(rec.trace.events)
+
+
+# ---- serve surface -------------------------------------------------------
+
+
+def _served_sched(tmp_path, obs=None):
+    d = str(tmp_path / "srv")
+    sched = Scheduler(
+        _cfg(), JobJournal(d), d, buckets=((2, 1),), chunk_steps=16,
+        max_queue=16, obs=obs,
+    )
+    jobs = [Job(job_id=f"j{i:06d}", synth=SMALL_SYNTH.format(i))
+            for i in range(3)]
+    for j in jobs:
+        sched.submit(j)
+    n = 0
+    while not all(j.terminal for j in jobs):
+        sched.tick()
+        n += 1
+        assert n < 5000
+    return sched, jobs
+
+
+def test_prometheus_text(tmp_path):
+    sched, jobs = _served_sched(tmp_path)
+    text = render_prometheus(sched, journal=sched.journal,
+                             recovered={"jobs_replayed": 0,
+                                        "jobs_requeued": 0})
+    assert all(j.state == "DONE" for j in jobs)
+    # required families (acceptance criteria: queue depth, job states,
+    # latency histogram)
+    for family in (
+        "primetpu_queue_depth",
+        'primetpu_jobs{state="DONE"} 3',
+        "primetpu_job_latency_seconds_bucket",
+        'primetpu_job_latency_seconds_bucket{le="+Inf"} 3',
+        "primetpu_job_latency_seconds_count 3",
+        "primetpu_jobs_completed_total 3",
+        "primetpu_journal_fsync_seconds_bucket",
+        "primetpu_slots_total 2",
+        "primetpu_last_dispatch_age_seconds",
+    ):
+        assert family in text, family
+    # text-format sanity: every non-comment line is `name[{labels}] value`
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE "))
+            continue
+        name_part, _, value = line.rpartition(" ")
+        assert name_part and value
+        float(value)  # parses as a number
+    # histogram buckets are cumulative (monotone non-decreasing)
+    buckets = [
+        float(ln.rpartition(" ")[2])
+        for ln in text.splitlines()
+        if ln.startswith("primetpu_job_latency_seconds_bucket")
+    ]
+    assert buckets == sorted(buckets)
+
+
+def test_scheduler_serve_events_in_trace(tmp_path):
+    rec = Recorder("full")
+    sched, jobs = _served_sched(tmp_path, obs=rec)
+    kinds = {e["name"] for e in rec.trace.events if e["ph"] == "i"}
+    assert {"admit", "dispatch", "retire"} <= kinds
+    # fleet chunk spans carry the per-bucket label
+    names = {e["args"]["name"] for e in rec.trace.events
+             if e["ph"] == "M"}
+    assert "bucket1p" in names
+    # journal fsyncs landed as spans once the server wires journal.obs
+    sched.journal.obs = rec
+    sched.journal.note("post-wire fsync")
+    assert any(
+        e["ph"] == "B" and e["name"] == "fsync"
+        for e in rec.trace.events
+    )
+    _validate_trace(rec.trace.events)
+
+
+def test_journal_fsync_histogram(tmp_path):
+    j = JobJournal(str(tmp_path / "jj"))
+    before = j.fsync_hist.count
+    j.note("one")
+    j.note("two")
+    assert j.fsync_hist.count == before + 2
+    assert j.fsync_hist.sum > 0
+
+
+def test_metrics_and_health_verbs(tmp_path):
+    """The daemon surface, exercised in-process (the sighup-test
+    pattern): `metrics` returns parseable Prometheus text, `health`
+    carries recovery + journal + last-dispatch info."""
+    from primesim_tpu.serve.server import PrimeServer
+
+    server = PrimeServer(
+        _cfg(), state_dir=str(tmp_path / "srv"), buckets=((2, 1),),
+        chunk_steps=16,
+    )
+    job = Job(job_id="", synth=SMALL_SYNTH.format(7))
+    job.job_id = server.sched.next_job_id()
+    server.sched.submit(job)
+    n = 0
+    while not job.terminal:
+        server.sched.tick()
+        n += 1
+        assert n < 5000
+
+    out = server._handle({"verb": "metrics"})
+    assert out["ok"] and out["content_type"].startswith("text/plain")
+    assert "primetpu_queue_depth" in out["text"]
+    assert 'primetpu_jobs{state="DONE"} 1' in out["text"]
+    assert "primetpu_journal_fsync_seconds_count" in out["text"]
+
+    h = server._handle({"verb": "health"})
+    assert h["ok"]
+    assert h["recovered"]["jobs_replayed"] == 0
+    assert h["journal"]["appends"] == server.journal.appended > 0
+    assert h["last_dispatch_t"] is not None
+    assert h["last_dispatch_age_s"] >= 0
+
+
+# ---- report TIMELINE -----------------------------------------------------
+
+
+def test_report_timeline_section():
+    from primesim_tpu.stats.report import render_report
+
+    cfg, tr = _cfg(), _trace()
+    rec = Recorder("basic")
+    eng = Engine(cfg, tr, chunk_steps=16)
+    rec.attach(eng)
+    eng.run_chunked()
+    with_tl = render_report(cfg, eng.counters, eng.cycles, wall_s=0.5,
+                            timeline=rec.timeline_summary())
+    assert "TIMELINE" in with_tl
+    assert "peak chunk MIPS" in with_tl
+    assert "slowest chunk" in with_tl
+    without = render_report(cfg, eng.counters, eng.cycles, wall_s=0.5)
+    assert "TIMELINE" not in without  # obs off leaves the report alone
