@@ -17,7 +17,9 @@ first-class gated metric since the sort-based FIFO ranking rework
 
 `PRIMETPU_BENCH_SERVE=0` skips the serve_throughput measurement (the
 continuous-batching scheduler at sustained 8-slot occupancy vs the
-static batch-8 sweep).
+static batch-8 sweep). `PRIMETPU_BENCH_FORK=0` skips the
+sweep_fork_speedup measurement (a 16-seed chaos campaign with the
+shared prefix forked once vs simulated 16 times, DESIGN.md §16).
 
 Rung-3 knobs: `PRIMETPU_BENCH_RUNG3=0` skips the rung-3 measurement;
 `PRIMETPU_BENCH_RUNG3_FLOOR=<mips>` makes the regression gate HARD
@@ -253,6 +255,84 @@ def main() -> None:
             "wall_s": round(wall_srv, 2),
         }
 
+    # prefix-fork speedup (DESIGN.md §16): a 16-seed chaos campaign on
+    # the rung-1 config with one late scheduled link-degrade. Every
+    # element shares the trace and the full timing-knob vector and can
+    # only diverge at the fault-schedule start, so the forked path
+    # simulates the shared prefix ONCE (solo Engine) and broadcasts the
+    # snapshot into all 16 fleet slots; the unforked fleet pays for that
+    # prefix 16 times. Wall-clock gate is advisory at 2.0x (never hard —
+    # the ratio depends on backend batching economics, see
+    # fleet_scaling). PRIMETPU_BENCH_FORK=0 skips (metric reports null).
+    fork_detail = None
+    fork_gate = None
+    if os.environ.get("PRIMETPU_BENCH_FORK", "1") != "0":
+        from primesim_tpu.config.machine import FAULT_LINK_DEGRADE
+        from primesim_tpu.sim.engine import Engine
+        from primesim_tpu.sim.fleet import FleetEngine
+        from primesim_tpu.sim.prefix import execute_prefix_plan, plan_prefix
+
+        B_FORK = 16
+        # fork granularity is chunk_steps: the run must span several
+        # chunks so a chunk-floored 3/4 fork point leaves a real tail —
+        # the headline CHUNK (512) would swallow this trace whole
+        FCHUNK = min(CHUNK, 128)
+        fork_trace = fold_ins(
+            synth.fft_like(
+                cfg1.n_cores, n_phases=4, points_per_core=256,
+                ins_per_mem=8, seed=97,
+            )
+        )
+        # place the scheduled event at ~3/4 of the run so the shared
+        # prefix dominates but every element still runs a real tail
+        probe = Engine(cfg1, fork_trace, chunk_steps=FCHUNK)
+        probe.run(max_steps=10_000_000)
+        ev_step = max(
+            FCHUNK, int(probe.steps_run) * 3 // 4 // FCHUNK * FCHUNK
+        )
+        cfg_fork = dataclasses.replace(
+            cfg1, faults_enabled=True, max_fault_events=1,
+            fault_events=((ev_step, FAULT_LINK_DEGRADE, 0, 4),),
+        )
+        fork_ovs = [{"fault_seed": 700 + b} for b in range(B_FORK)]
+        fork_traces = [fork_trace] * B_FORK
+
+        def _campaign(forked: bool):
+            fl = FleetEngine(
+                cfg_fork, fork_traces, fork_ovs, chunk_steps=FCHUNK
+            )
+            fl.block_until_ready()
+            t0 = time.perf_counter()
+            pre = 0
+            if forked:
+                groups = plan_prefix(
+                    fl.elem_cfgs, fl.traces, mode="auto",
+                    chunk_steps=FCHUNK, cap=10_000_000,
+                )
+                pre = execute_prefix_plan(fl, groups)["prefix_steps"]
+            fl.run(max_steps=10_000_000)
+            return time.perf_counter() - t0, pre
+
+        _campaign(False)  # compile the fleet program
+        _campaign(True)  # compile the solo prefix program
+        wall_unforked = min(_campaign(False)[0] for _ in range(2))
+        forked_runs = [_campaign(True) for _ in range(2)]
+        wall_forked = min(w for w, _ in forked_runs)
+        fork_speedup = wall_unforked / wall_forked
+        fork_detail = {
+            "elements": B_FORK,
+            "divergence_step": int(ev_step),
+            "prefix_steps": int(forked_runs[0][1]),
+            "wall_s_unforked": round(wall_unforked, 3),
+            "wall_s_forked": round(wall_forked, 3),
+            "speedup_x": round(fork_speedup, 3),
+        }
+        fork_gate = {
+            "floor_x": 2.0,
+            "hard": False,
+            "passed": bool(fork_speedup >= 2.0),
+        }
+
     # telemetry overhead (DESIGN.md §15 overhead contract): wall time of
     # the chunked engine with the --obs basic metric ring attached vs the
     # identical chunked dispatch with obs off, on the headline machine
@@ -357,6 +437,12 @@ def main() -> None:
                     "obs_overhead_pct": (
                         obs_detail["overhead_pct"] if obs_detail else None
                     ),
+                    # 16-seed chaos campaign forked at the fault-schedule
+                    # start vs unforked (null when PRIMETPU_BENCH_FORK=0;
+                    # advisory gate >= 2.0x)
+                    "sweep_fork_speedup": (
+                        fork_detail["speedup_x"] if fork_detail else None
+                    ),
                 },
                 "detail": {
                     "n_cores": C,
@@ -391,6 +477,11 @@ def main() -> None:
                     # continuous-batching service throughput at sustained
                     # 8-slot occupancy (null when PRIMETPU_BENCH_SERVE=0)
                     "serve_throughput": serve_detail,
+                    # prefix-fork campaign economics (DESIGN.md §16):
+                    # shared prefix simulated once vs 16 times (null when
+                    # PRIMETPU_BENCH_FORK=0)
+                    "sweep_fork": fork_detail,
+                    "sweep_fork_gate": fork_gate,
                     # STATIC RECORD: round-5 restructure evidence measured
                     # on TPU 2026-07-30 (scripts/prof/prof_phase.py
                     # cumulative cuts / prof_bisect.py ablations,

@@ -468,19 +468,49 @@ def cmd_capture(ns) -> int:
         src.close()
 
 
+class VarySpecError(ValueError):
+    """A malformed --vary spec (bad shape, unknown key, non-integer
+    value). Typed like TraceError/FaultConfigError so `main` exits 2
+    with the structured {"error": ...} JSON instead of a bare usage
+    message — sweep specs come from scripts at least as often as from
+    hands, and scripts parse one error grammar everywhere."""
+
+    def __init__(self, msg: str, pair: str | None = None):
+        super().__init__(msg)
+        self.pair = pair
+
+    def location(self) -> dict:
+        return {"pair": self.pair} if self.pair is not None else {}
+
+
 def _parse_vary(spec: str) -> dict:
     """Parse one --vary spec 'k=v[,k=v...]' into a timing-override dict
-    (keys validated against sim.fleet.KNOB_KEYS by the FleetEngine)."""
+    (keys validated against sim.fleet.KNOB_KEYS here AND by the
+    FleetEngine — here so the error names the offending pair)."""
+    from ..sim.fleet import KNOB_KEYS
+
     ov = {}
     for pair in spec.split(","):
         k, eq, v = pair.partition("=")
         if not eq or not k:
-            raise SystemExit(f"bad --vary arg {pair!r} (want key=value)")
+            raise VarySpecError(
+                f"bad --vary arg {pair!r} (want key=value; valid keys: "
+                f"{', '.join(KNOB_KEYS)})",
+                pair=pair,
+            )
+        if k not in KNOB_KEYS:
+            raise VarySpecError(
+                f"bad --vary arg {pair!r}: unknown key {k!r} (valid keys: "
+                f"{', '.join(KNOB_KEYS)})",
+                pair=pair,
+            )
         try:
             ov[k] = int(v)
         except ValueError:
-            raise SystemExit(
-                f"bad --vary arg {pair!r}: value must be an integer"
+            raise VarySpecError(
+                f"bad --vary arg {pair!r}: value must be an integer "
+                f"(valid keys: {', '.join(KNOB_KEYS)})",
+                pair=pair,
             ) from None
     return ov
 
@@ -499,6 +529,14 @@ def cmd_sweep(ns) -> int:
     any bad element fatal instead."""
     import os
 
+    if ns.fork_prefix not in ("auto", "off"):
+        try:
+            int(ns.fork_prefix)
+        except ValueError:
+            raise SystemExit(
+                f"sweep: --fork-prefix must be auto, off, or an integer "
+                f"step cap (got {ns.fork_prefix!r})"
+            ) from None
     cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
     _check_supervision_flags(ns)
     from ..trace.format import Trace, TraceError, fold_ins
@@ -577,6 +615,37 @@ def cmd_sweep(ns) -> int:
         print("sweep: every element was quarantined", file=sys.stderr)
         return 1
 
+    # identical-element dedup: two elements with equal (trace, effective
+    # config) would simulate the same run twice — keep the first, fan its
+    # report out to the twins afterwards (caller indices are preserved
+    # via element_ids, same as quarantine)
+    from ..sim.prefix import dedup_plan, execute_prefix_plan, plan_prefix
+
+    dup_of_caller: dict[int, int] = {}
+    if fleet.n_elements > 1:
+        keep, dup_of = dedup_plan(fleet.elem_cfgs, fleet.traces)
+        if dup_of:
+            ids = fleet.element_ids
+            dup_of_caller = {ids[j]: ids[k] for j, k in dup_of.items()}
+            print(
+                "sweep: WARNING: deduplicated "
+                f"{len(dup_of)} identical element(s) — "
+                + ", ".join(
+                    f"{ids[j]} duplicates {ids[k]}"
+                    for j, k in sorted(dup_of.items())
+                )
+                + " (simulated once, reports fanned out)",
+                file=sys.stderr,
+            )
+            kept_ids = [ids[j] for j in keep]
+            fleet = FleetEngine(
+                cfg,
+                [fleet.traces[j] for j in keep],
+                [fleet.element_overrides[j] for j in keep],
+                chunk_steps=ns.chunk_steps,
+            )
+            fleet.element_ids = kept_ids
+
     # warm the jit cache at the fleet's shapes (one chunk) — the shared
     # protocol: reported MIPS measures simulation, not compilation. The
     # supervised path dispatches fleet_run_chunk (chunk-committed), the
@@ -600,11 +669,45 @@ def cmd_sweep(ns) -> int:
     fleet.block_until_ready()
     if rec is not None:
         rec.attach(fleet)
+
+    def _fork_now() -> dict:
+        # run (or warm-load) each prefix-sharing class's shared prefix
+        # and fork it into the slots; the metric line is the scriptable
+        # record of what was skipped (CI parses cache_hits from it)
+        groups = plan_prefix(
+            fleet.elem_cfgs,
+            fleet.traces,
+            mode=ns.fork_prefix,
+            chunk_steps=ns.chunk_steps,
+            cap=ns.max_steps or 10_000_000,
+        )
+        st = execute_prefix_plan(
+            fleet, groups, warm_cache=ns.warm_cache == "on", obs=rec
+        )
+        st["mode"] = ns.fork_prefix
+        st["warm_cache"] = ns.warm_cache
+        if dup_of_caller:
+            st["deduped"] = sorted(dup_of_caller)
+        print(
+            json.dumps(
+                {
+                    "metric": "prefix_fork",
+                    "value": st["forked_elements"],
+                    "unit": "elements",
+                    "detail": st,
+                }
+            )
+        )
+        return st
+
     stalled: list[int] = []
     if supervised:
         sup = _build_supervisor(ns, fleet, obs=rec)
-        if ns.resume:
-            sup.resume()
+        resumed = sup.resume() if ns.resume else None
+        if resumed is None and ns.fork_prefix != "off":
+            # a restored snapshot is already past the prefix (and carries
+            # its fork provenance); fork only on a fresh start
+            _fork_now()
         t0 = time.perf_counter()
         try:
             sup.run(max_steps=ns.max_steps or 10_000_000)
@@ -616,6 +719,8 @@ def cmd_sweep(ns) -> int:
         for line in sup.log_lines():
             print(f"supervisor: {line}", file=sys.stderr)
     else:
+        if ns.fork_prefix != "off":
+            _fork_now()
         t0 = time.perf_counter()
         try:
             if rec is not None:
@@ -682,6 +787,45 @@ def cmd_sweep(ns) -> int:
                 title=f"primesim_tpu fleet element {i}",
             )
             print(f"report written to {path}", file=sys.stderr)
+    # fan the deduplicated twins' reports out: identical inputs give
+    # identical results, copied from the element that actually simulated
+    # (dedup_of names it); they don't add to the aggregate — no extra
+    # instructions were retired on their behalf
+    for i, twin in sorted(dup_of_caller.items()):
+        jt = fleet.element_ids.index(twin)
+        ec = {k: v[jt] for k, v in counters.items()}
+        ins = int(ec["instructions"].sum())
+        detail = {
+            "engine": "fleet",
+            "fleet_index": i,
+            "n_cores": cfg.n_cores,
+            "instructions": ins,
+            "max_core_cycles": int(cycles[jt].max()),
+            "overrides": ovs[i],
+            "wall_s": round(wall, 3),
+            "noc_msgs": int(ec["noc_msgs"].sum()),
+            "dedup_of": twin,
+        }
+        if twin in stalled:
+            detail["status"] = "stalled"
+        print(
+            json.dumps(
+                {
+                    "metric": "simulated_MIPS",
+                    "value": round(ins / wall / 1e6, 3),
+                    "unit": "MIPS",
+                    "detail": detail,
+                }
+            )
+        )
+        if ns.report_dir:
+            path = os.path.join(ns.report_dir, f"element_{i}.txt")
+            write_report(
+                path, fleet.elem_cfgs[jt], ec, cycles[jt], wall_s=wall,
+                per_core_limit=ns.per_core_limit,
+                title=f"primesim_tpu fleet element {i} (dedup of {twin})",
+            )
+            print(f"report written to {path}", file=sys.stderr)
     agg_detail = {
         "engine": "fleet",
         "n_elements": fleet.n_elements,
@@ -689,6 +833,8 @@ def cmd_sweep(ns) -> int:
         "instructions": total_ins,
         "wall_s": round(wall, 3),
     }
+    if dup_of_caller:
+        agg_detail["deduplicated"] = sorted(dup_of_caller)
     if quarantined:
         agg_detail["quarantined"] = [i for i, _ in quarantined]
     if stalled:
@@ -783,6 +929,7 @@ def cmd_serve(ns) -> int:
         config_path=ns.config,
         idle_exit_s=ns.idle_exit,
         obs=rec,
+        warm_cache=ns.warm_cache == "on",
     )
     print(
         f"serve: listening on {server.socket_path} "
@@ -1128,6 +1275,19 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--chunk-steps", type=int, default=256)
     w.add_argument("--max-steps", type=int, default=None)
     w.add_argument(
+        "--fork-prefix", default="off", metavar="auto|off|N",
+        help="run each prefix-sharing class's shared prefix ONCE as a "
+             "solo engine and fork it into the fleet slots (bit-exact; "
+             "'auto' forks at the divergence point, an integer caps the "
+             "prefix at N steps; default off)",
+    )
+    w.add_argument(
+        "--warm-cache", choices=("on", "off"), default="off",
+        help="consult/populate the on-disk warm-state cache "
+             "($PRIMETPU_CACHE_DIR) for forked prefixes — a repeated "
+             "campaign skips the prefix simulation entirely",
+    )
+    w.add_argument(
         "--report-dir", help="write per-element text reports to this directory"
     )
     w.add_argument("--per-core-limit", type=int, default=64)
@@ -1213,6 +1373,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--report", metavar="PATH",
         help="write a text report with the SERVICE section at drain",
     )
+    v.add_argument(
+        "--warm-cache", choices=("on", "off"), default="off",
+        help="consult the on-disk warm-state cache at admission: a "
+             "resubmitted (trace, config) job starts from the deepest "
+             "matching cached state instead of step 0",
+    )
     _add_fault_flags(v)
     _add_obs_flags(v)
     v.set_defaults(fn=cmd_serve)
@@ -1290,7 +1456,7 @@ def main(argv=None) -> int:
 
     try:
         return ns.fn(ns)
-    except (TraceError, FaultConfigError, CheckpointCorrupt) as e:
+    except (TraceError, FaultConfigError, CheckpointCorrupt, VarySpecError) as e:
         # typed errors exit 2 with ONE structured JSON line on stderr —
         # {"error": {type, location, detail}} — the same shape the serve
         # protocol and sweep quarantine lines use, so scripts parse one

@@ -68,11 +68,20 @@ class MetricStore:
         peak_seq = slowest_seq = -1
         slowest_wall = -1.0
         total_steps = total_ins = 0
+        labels: dict = {}
         for s in self._ring:
             ins = s["deltas"].get("instructions", 0)
             wall = s["wall_s"]
             total_steps += s["steps"]
             total_ins += ins
+            lab = labels.setdefault(
+                s["label"],
+                {"chunks": 0, "steps": 0, "wall_s": 0.0, "instructions": 0},
+            )
+            lab["chunks"] += 1
+            lab["steps"] += s["steps"]
+            lab["wall_s"] += wall
+            lab["instructions"] += ins
             if wall > 0:
                 mips = ins / wall / 1e6
                 if mips > peak:
@@ -82,6 +91,7 @@ class MetricStore:
             if wall > slowest_wall:
                 slowest_wall, slowest_seq = wall, s["seq"]
         return {
+            "labels": labels,
             "chunks": self.seq,
             "retained": len(self._ring),
             "dropped": self.dropped,

@@ -95,6 +95,15 @@ class Recorder:
         if self.trace is not None:
             self.trace.complete("journal", "fsync", wall_s)
 
+    def prefix_event(self, kind, **args) -> None:
+        """Warm-cache / prefix-fork instant (hit, miss, store, corrupt
+        fallback) on the ``prefix`` track — the TIMELINE's evidence that
+        a campaign skipped (or paid for) its shared prefix."""
+        if self.trace is not None:
+            self.trace.instant(
+                "prefix", kind, {k: str(v) for k, v in args.items()}
+            )
+
     # ---- output ----------------------------------------------------------
 
     def timeline_summary(self):

@@ -189,10 +189,20 @@ class Scheduler:
         checkpoint_every_s: float = 2.0,
         max_retries: int = 2,
         obs=None,
+        warm_cache: bool = False,
     ):
         self.cfg = cfg
         self.journal = journal
         self.obs = obs
+        # warm-state cache consult at admission (DESIGN.md §16): a
+        # resubmitted (trace, config) job starts from the deepest cached
+        # snapshot whose content key matches, instead of step 0
+        if warm_cache:
+            from ..sim.checkpoint import warm_cache_root
+
+            self.warm_root = warm_cache_root()
+        else:
+            self.warm_root = None
         self.state_dir = str(state_dir)
         self.jobs_dir = os.path.join(self.state_dir, "jobs")
         os.makedirs(self.jobs_dir, exist_ok=True)
@@ -406,6 +416,7 @@ class Scheduler:
             i, job._trace, base_cfg=job._elem_cfg, upload=upload
         )
         resumed = False
+        warm_steps = 0
         if job._resume_from:
             try:
                 snap = load_element_checkpoint(
@@ -418,6 +429,46 @@ class Scheduler:
                     f"{job.job_id}: element checkpoint unusable "
                     f"({type(e).__name__}: {e}); restarting from step 0"
                 )
+        if not resumed and self.warm_root is not None:
+            # no mid-run checkpoint of its own: check the warm cache. The
+            # content key proves the first `steps` steps of this exact
+            # (trace, config) workload; fork_element reseeds the traced
+            # fault inputs so a schedule/seed difference past the prefix
+            # stays the job's own
+            from ..sim.checkpoint import (
+                CheckpointCorrupt,
+                find_warm_states,
+                load_warm_state,
+                trace_fingerprint,
+            )
+
+            fp = trace_fingerprint(job._trace)
+            for steps, key in find_warm_states(
+                self.warm_root, job._elem_cfg, fp
+            ):
+                if steps >= job.max_steps:
+                    continue  # would overshoot the job's step budget
+                try:
+                    snap = load_warm_state(
+                        self.warm_root, key, job._elem_cfg, fp, steps
+                    )
+                except (FileNotFoundError, CheckpointCorrupt, ValueError) as e:
+                    self.journal.note(
+                        f"{job.job_id}: warm entry {key[:12]} unusable "
+                        f"({type(e).__name__}); trying next"
+                    )
+                    continue
+                b.fleet.fork_element(i, snap, cache_key=key)
+                warm_steps = steps
+                self.journal.note(
+                    f"{job.job_id}: admitted from warm cache at step "
+                    f"{steps} (key {key[:12]})"
+                )
+                if self.obs is not None:
+                    self.obs.prefix_event(
+                        "warm-hit", job_id=job.job_id, key=key, steps=steps
+                    )
+                break
         b.slots[i] = job
         job.attempts += 1
         job.transition(J.RUNNING)
@@ -425,11 +476,12 @@ class Scheduler:
         self.journal.state(
             job.job_id, J.RUNNING,
             detail={"attempt": job.attempts, "resumed": resumed,
+                    "warm_steps": warm_steps,
                     "bucket_pages": b.n_pages, "slot": i},
         )
         self._serve_event("dispatch", job_id=job.job_id, slot=i,
                           bucket_pages=b.n_pages, attempt=job.attempts,
-                          resumed=resumed)
+                          resumed=resumed, warm_steps=warm_steps)
 
     def _slot_of(self, job: J.Job) -> tuple[SlotBucket, int] | None:
         for b in self.buckets:

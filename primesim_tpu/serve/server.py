@@ -64,6 +64,7 @@ class PrimeServer:
         config_path: str | None = None,
         idle_exit_s: float | None = None,
         obs=None,
+        warm_cache: bool = False,
     ):
         self.state_dir = str(state_dir)
         os.makedirs(self.state_dir, exist_ok=True)
@@ -84,6 +85,7 @@ class PrimeServer:
             max_queue=max_queue,
             checkpoint_every_s=checkpoint_every_s,
             obs=obs,
+            warm_cache=warm_cache,
         )
         self.inbox: "queue.Queue[_Request]" = queue.Queue()
         self._draining = False

@@ -35,13 +35,16 @@ from ..faults.schedule import FaultState
 from ..stats.counters import COUNTER_NAMES
 from .state import MachineState, TimingKnobs
 
-_FORMAT = 5  # v3: fused dirm row (metadata + sharers) replaces
+_FORMAT = 6  # v3: fused dirm row (metadata + sharers) replaces
 # llc_meta/sharers; 5-plane l1; link_free/dram_free queue clocks.
 # v4: nested TimingKnobs state field (flattened to state_knobs__<name>
 # keys — npz holds flat arrays only).
 # v5: nested FaultState field (state_faults__<name>) + four fault
 # counters — resuming a chaos run replays the surviving schedule and
 # dead-core/link masks bit-exactly.
+# v6: prefix-fork provenance (prefix_steps + warm-cache key) on solo,
+# fleet, and element snapshots — --resume of a forked run is
+# self-describing, and the warm-state cache (below) shares the format.
 
 # nested-NamedTuple state fields and their types (flattened by
 # _state_arrays to `state_<field>__<sub>` keys; extend here when a new
@@ -141,6 +144,25 @@ def load_verified_npz(path: str) -> dict[str, np.ndarray]:
     return data
 
 
+def _require_format(z, path: str) -> None:
+    """Loud typed rejection of any snapshot not written by this build's
+    format. Older formats predate prefix-fork provenance (v6) and would
+    resume with silently-missing fields; newer ones may reinterpret
+    arrays. Either way the answer is the same: regenerate, don't guess."""
+    got = int(z["format"]) if "format" in z else None
+    if got != _FORMAT:
+        raise ValueError(
+            f"{path}: unsupported checkpoint format {got} (this build "
+            f"reads format {_FORMAT} only — re-run to regenerate the "
+            "snapshot)"
+        )
+
+
+def _str_field(z, key: str) -> str:
+    """Decode an optional uint8-string npz member ('' when absent)."""
+    return bytes(z[key]).decode() if key in z else ""
+
+
 def _state_arrays(st: MachineState) -> dict[str, np.ndarray]:
     """Flatten the state pytree to npz-storable arrays: plain fields as
     `state_<name>`, nested NamedTuples (_NESTED) as
@@ -197,6 +219,11 @@ def save_checkpoint(path: str, engine) -> None:
         format=np.int64(_FORMAT),
         cycle_base=np.int64(engine.cycle_base),
         steps_run=np.int64(engine.steps_run),
+        prefix_steps=np.int64(getattr(engine, "prefix_steps", 0) or 0),
+        prefix_cache_key=np.frombuffer(
+            str(getattr(engine, "prefix_cache_key", "") or "").encode(),
+            dtype=np.uint8,
+        ),
         config_json=np.frombuffer(
             engine.cfg.to_json().encode(), dtype=np.uint8
         ),
@@ -239,7 +266,8 @@ def load_stream_checkpoint(path: str, eng) -> None:
     re-fills the window from the restored cursors — bit-exact with an
     uninterrupted run (tests/test_checkpoint.py)."""
     z = load_verified_npz(path)
-    if int(z["format"]) != _FORMAT or "stream" not in z:
+    _require_format(z, path)
+    if "stream" not in z:
         raise ValueError(f"{path}: not a compatible streaming checkpoint")
     if MachineConfig.from_json(bytes(z["config_json"]).decode()) != eng.cfg:
         raise ValueError(f"{path}: checkpoint config does not match engine")
@@ -267,8 +295,7 @@ def load_checkpoint(path: str, engine) -> None:
     the checkpoint was taken under (validated by fingerprint).
     """
     z = load_verified_npz(path)
-    if int(z["format"]) != _FORMAT:
-        raise ValueError(f"{path}: unsupported checkpoint format {int(z['format'])}")
+    _require_format(z, path)
     if "stream" in z:
         raise ValueError(
             f"{path}: streaming checkpoint — resume it with a StreamEngine"
@@ -304,6 +331,8 @@ def load_checkpoint(path: str, engine) -> None:
     engine.state = st
     engine.cycle_base = np.int64(z["cycle_base"])
     engine.steps_run = int(z["steps_run"])
+    engine.prefix_steps = int(z["prefix_steps"]) if "prefix_steps" in z else 0
+    engine.prefix_cache_key = _str_field(z, "prefix_cache_key") or None
     hc = z["host_counters"]
     engine.host_counters = {
         k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
@@ -322,12 +351,19 @@ def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "") -> None:
     arrays["host_counters"] = np.stack(
         [fleet.host_counters[k][i] for k in COUNTER_NAMES]
     )  # [n_counters, C]
+    pre = getattr(fleet, "prefix_steps", None)
+    keys = getattr(fleet, "prefix_cache_keys", None)
     atomic_save_npz(
         path,
         format=np.int64(_FORMAT),
         element=np.int64(1),
         cycle_base=np.int64(fleet.cycle_base[i]),
         steps_run=np.int64(fleet.steps_run[i]),
+        prefix_steps=np.int64(int(pre[i]) if pre is not None else 0),
+        prefix_cache_key=np.frombuffer(
+            str((keys[i] if keys is not None else "") or "").encode(),
+            dtype=np.uint8,
+        ),
         job_id=np.frombuffer(str(job_id).encode(), dtype=np.uint8),
         config_json=np.frombuffer(
             fleet.elem_cfgs[i].to_json().encode(), dtype=np.uint8
@@ -345,7 +381,8 @@ def load_element_checkpoint(path: str, cfg, trace) -> dict:
     loader). Returns the dict `FleetEngine.restore_element` consumes:
     solo-shaped state, 64-bit cycle base / step count, host counters."""
     z = load_verified_npz(path)
-    if int(z["format"]) != _FORMAT or "element" not in z:
+    _require_format(z, path)
+    if "element" not in z:
         raise ValueError(f"{path}: not a compatible element checkpoint")
     if MachineConfig.from_json(bytes(z["config_json"]).decode()) != cfg:
         raise ValueError(f"{path}: checkpoint config does not match job")
@@ -363,6 +400,8 @@ def load_element_checkpoint(path: str, cfg, trace) -> dict:
         "cycle_base": np.int64(z["cycle_base"]),
         "steps_run": np.int64(z["steps_run"]),
         "job_id": bytes(z["job_id"]).decode(),
+        "prefix_steps": int(z["prefix_steps"]) if "prefix_steps" in z else 0,
+        "prefix_cache_key": _str_field(z, "prefix_cache_key") or None,
         "host_counters": {
             k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
         },
@@ -379,12 +418,21 @@ def save_fleet_checkpoint(path: str, fleet) -> None:
     arrays["host_counters"] = np.stack(
         [fleet.host_counters[k] for k in COUNTER_NAMES]
     )  # [n_counters, B, C]
+    B = len(fleet.elem_cfgs)
+    pre = getattr(fleet, "prefix_steps", None)
+    if pre is None:
+        pre = np.zeros(B, np.int64)
+    keys = getattr(fleet, "prefix_cache_keys", None) or [None] * B
     atomic_save_npz(
         path,
         format=np.int64(_FORMAT),
         fleet=np.int64(1),
         cycle_base=fleet.cycle_base,  # [B] int64
         steps_run=fleet.steps_run,  # [B] int64
+        prefix_steps=np.asarray(pre, np.int64),  # [B]
+        prefix_keys_json=np.frombuffer(
+            json.dumps([k or None for k in keys]).encode(), dtype=np.uint8
+        ),
         configs_json=np.frombuffer(
             json.dumps(
                 [json.loads(c.to_json()) for c in fleet.elem_cfgs]
@@ -405,7 +453,8 @@ def load_fleet_checkpoint(path: str, fleet) -> None:
     axis is positional). Resuming is bit-exact per element
     (tests/test_checkpoint.py)."""
     z = load_verified_npz(path)
-    if int(z["format"]) != _FORMAT or "fleet" not in z:
+    _require_format(z, path)
+    if "fleet" not in z:
         raise ValueError(f"{path}: not a compatible fleet checkpoint")
     cfgs = [
         MachineConfig.from_dict(d)
@@ -429,7 +478,260 @@ def load_fleet_checkpoint(path: str, fleet) -> None:
     fleet.state = _state_from(z)
     fleet.cycle_base = z["cycle_base"].astype(np.int64)
     fleet.steps_run = z["steps_run"].astype(np.int64)
+    if "prefix_steps" in z:
+        fleet.prefix_steps = z["prefix_steps"].astype(np.int64)
+    if "prefix_keys_json" in z:
+        fleet.prefix_cache_keys = json.loads(
+            bytes(z["prefix_keys_json"]).decode()
+        )
     hc = z["host_counters"]
     fleet.host_counters = {
         k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
     }
+
+
+# ---------------------------------------------------------------------------
+# Warm-state cache (prefix forking, DESIGN.md §16)
+#
+# Content-addressed on-disk snapshots of a solo engine after P steps of a
+# workload. An entry is valid for ANY run whose first P steps are provably
+# identical to the producer's, which the key enforces by hashing exactly
+# the inputs that can influence those steps:
+#
+#   - checkpoint format (state layout identity)
+#   - trace fingerprint (events + lengths + addressing)
+#   - normalized-geometry hash (cfg.timing_normalized().to_json() — core
+#     count, cache shapes, mesh, model selectors, fault capacity/policies)
+#   - timing-knob values (knobs_from_config leaves; traced, so not part
+#     of the geometry hash)
+#   - the fault-schedule PREFIX: scheduled events with step < P (an event
+#     at step S fires while executing step index S, so a P-step run fires
+#     exactly the events with step < P)
+#   - the ECC block (seed + flip/due thresholds) ONLY when a flip rate is
+#     nonzero — with both flip thresholds 0 the per-step site hashes are
+#     never < threshold, so the seed is architecturally unreachable and
+#     seed-varying sweep elements must share one entry
+#   - P itself
+#
+# chunk_steps is deliberately NOT part of the key: every absolute
+# observable after P steps is chunking-invariant (the cycle_base/cycles
+# split differs by quantum-multiple rebases, but dynamics depend only on
+# relative clocks).
+# ---------------------------------------------------------------------------
+
+_WARM_DEFAULT_MAX_BYTES = 2 << 30  # 2 GiB before LRU eviction kicks in
+
+
+def warm_cache_root() -> str:
+    """The warm-cache directory: $PRIMETPU_CACHE_DIR, or a per-user
+    default under ~/.cache. Created on first use."""
+    root = os.environ.get("PRIMETPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "primetpu", "warm"
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _geometry_hash(cfg) -> str:
+    return hashlib.sha256(cfg.timing_normalized().to_json().encode()).hexdigest()
+
+
+def _warm_payload(cfg, trace_fp: str) -> dict:
+    """The step-count-independent part of the cache key (see module-level
+    derivation note above)."""
+    from .state import knobs_from_config
+
+    kn = knobs_from_config(cfg)
+    payload = {
+        "format": _FORMAT,
+        "trace": str(trace_fp),
+        "geom": _geometry_hash(cfg),
+        "knobs": {
+            k: np.asarray(v).tolist() for k, v in kn._asdict().items()
+        },
+    }
+    if (
+        float(cfg.fault_flip_l1) > 0.0
+        or float(cfg.fault_flip_llc) > 0.0
+        or float(cfg.fault_due_rate) > 0.0
+    ):
+        payload["ecc"] = {
+            "seed": int(cfg.fault_seed),
+            "flip_l1": float(cfg.fault_flip_l1),
+            "flip_llc": float(cfg.fault_flip_llc),
+            "due_rate": float(cfg.fault_due_rate),
+        }
+    return payload
+
+
+def warm_cfg_key(cfg, trace_fp: str) -> str:
+    """Hash of the step-independent key inputs — the sidecar index key
+    `find_warm_states` scans by."""
+    blob = json.dumps(_warm_payload(cfg, trace_fp), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def warm_key(cfg, trace_fp: str, steps: int) -> str:
+    """The full content-address of a warm entry: step-independent payload
+    + the fault-schedule prefix (events with step < steps) + steps."""
+    payload = _warm_payload(cfg, trace_fp)
+    payload["events"] = sorted(
+        tuple(int(x) for x in e)
+        for e in getattr(cfg, "fault_events", ()) or ()
+        if int(e[0]) < int(steps)
+    )
+    payload["steps"] = int(steps)
+    blob = json.dumps(payload, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _warm_paths(root: str, key: str) -> tuple[str, str]:
+    return os.path.join(root, f"{key}.npz"), os.path.join(root, f"{key}.json")
+
+
+def save_warm_state(root: str, cfg, trace_fp: str, steps: int, snap: dict) -> str:
+    """Write a warm entry (atomic npz + JSON sidecar) and LRU-prune.
+
+    `snap` is the restore_element-shaped dict a prefix run produces:
+    {state, cycle_base, steps_run, host_counters}. Returns the key."""
+    key = warm_key(cfg, trace_fp, steps)
+    os.makedirs(root, exist_ok=True)
+    npz_path, meta_path = _warm_paths(root, key)
+    arrays = _state_arrays(snap["state"])
+    arrays["host_counters"] = np.stack(
+        [snap["host_counters"][k] for k in COUNTER_NAMES]
+    )
+    atomic_save_npz(
+        npz_path,
+        format=np.int64(_FORMAT),
+        warm=np.int64(1),
+        steps=np.int64(steps),
+        cycle_base=np.int64(snap["cycle_base"]),
+        steps_run=np.int64(snap["steps_run"]),
+        trace_sha=np.frombuffer(str(trace_fp).encode(), dtype=np.uint8),
+        **arrays,
+    )
+    meta = {
+        "cfg_key": warm_cfg_key(cfg, trace_fp),
+        "key": key,
+        "trace_sha": str(trace_fp),
+        "steps": int(steps),
+    }
+    tmp = f"{meta_path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, meta_path)
+    prune_warm_cache(root)
+    return key
+
+
+def load_warm_state(root: str, key: str, cfg, trace_fp: str, steps: int) -> dict:
+    """Load + verify a warm entry and return the restore/fork dict.
+
+    Raises FileNotFoundError when absent (a plain miss), CheckpointCorrupt
+    when the file is torn or tampered (the caller recomputes), and
+    ValueError when the entry doesn't match the requested identity (a
+    hash collision or a renamed file — also recompute)."""
+    npz_path, _ = _warm_paths(root, key)
+    z = load_verified_npz(npz_path)
+    _require_format(z, npz_path)
+    if "warm" not in z:
+        raise ValueError(f"{npz_path}: not a warm-state cache entry")
+    if int(z["steps"]) != int(steps):
+        raise ValueError(
+            f"{npz_path}: entry holds {int(z['steps'])} steps, wanted {steps}"
+        )
+    if bytes(z["trace_sha"]).decode() != str(trace_fp):
+        raise ValueError(f"{npz_path}: entry trace does not match workload")
+    if warm_key(cfg, trace_fp, steps) != key:
+        raise ValueError(f"{npz_path}: entry key does not match workload")
+    if z["state_counters"].shape[0] != len(COUNTER_NAMES):
+        raise ValueError(
+            f"{npz_path}: incompatible counter-row count "
+            f"{z['state_counters'].shape[0]}"
+        )
+    try:
+        now = None  # LRU touch: refresh mtime so eviction is usage-ordered
+        os.utime(npz_path, now)
+    except OSError:
+        pass
+    hc = z["host_counters"]
+    return {
+        "state": _state_from(z),
+        "cycle_base": np.int64(z["cycle_base"]),
+        "steps_run": np.int64(z["steps_run"]),
+        "host_counters": {
+            k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
+        },
+    }
+
+
+def find_warm_states(root: str, cfg, trace_fp: str) -> list[tuple[int, str]]:
+    """Scan the cache for entries reusable by (cfg, trace): sidecars whose
+    cfg_key matches AND whose full key recomputes identically under this
+    cfg (which checks the fault-schedule prefix below the entry's step
+    count). Returns [(steps, key)] sorted deepest-first; unreadable
+    sidecars are skipped (the npz CRC check still guards the load)."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    want_cfg = warm_cfg_key(cfg, trace_fp)
+    out = []
+    for name in names:
+        if not name.endswith(".json") or name.endswith(".json.tmp"):
+            continue
+        try:
+            with open(os.path.join(root, name)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if meta.get("cfg_key") != want_cfg:
+            continue
+        steps = int(meta.get("steps", 0))
+        key = str(meta.get("key", ""))
+        if steps > 0 and key and warm_key(cfg, trace_fp, steps) == key:
+            out.append((steps, key))
+    out.sort(key=lambda sk: (-sk[0], sk[1]))
+    return out
+
+
+def prune_warm_cache(root: str, max_bytes: int | None = None) -> int:
+    """Evict least-recently-used entries until the cache fits under
+    `max_bytes` (default $PRIMETPU_CACHE_MAX_BYTES or 2 GiB). Returns the
+    number of entries removed. Hits refresh mtime, so mtime order IS use
+    order."""
+    if max_bytes is None:
+        max_bytes = int(
+            os.environ.get("PRIMETPU_CACHE_MAX_BYTES", _WARM_DEFAULT_MAX_BYTES)
+        )
+    entries = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(".npz"):
+            continue
+        path = os.path.join(root, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        entries.append((st.st_mtime, st.st_size, path))
+    total = sum(e[1] for e in entries)
+    entries.sort()  # oldest first
+    removed = 0
+    for mtime, size, path in entries:
+        if total <= max_bytes:
+            break
+        for victim in (path, path[: -len(".npz")] + ".json"):
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass
+        total -= size
+        removed += 1
+    return removed

@@ -2011,6 +2011,11 @@ class Engine:
         # consults it at all (DESIGN.md §15 overhead contract)
         self.obs = None
         self.obs_label = "engine"
+        # prefix-fork provenance (checkpoint format v6): nonzero when this
+        # engine's state was seeded from a shared-prefix / warm-cache
+        # snapshot rather than run from step 0
+        self.prefix_steps = 0
+        self.prefix_cache_key = None
 
     def _drain(self) -> None:
         cnt = _np(self.state.counters)

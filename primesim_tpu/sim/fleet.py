@@ -292,6 +292,11 @@ class FleetEngine:
         # branch in the chunked loops; fleet_run_loop never consults it
         self.obs = None
         self.obs_label = "fleet"
+        # prefix-fork provenance (checkpoint format v6): steps of shared
+        # prefix each element was forked from, and the warm-cache key the
+        # prefix was saved/loaded under (None = element ran from step 0)
+        self.prefix_steps = np.zeros(B, np.int64)
+        self.prefix_cache_keys: list = [None] * B
 
     # ---- batched bookkeeping (Engine's host helpers, vectorized) ---------
 
@@ -595,6 +600,8 @@ class FleetEngine:
         )
         self.cycle_base[i] = 0
         self.steps_run[i] = 0
+        self.prefix_steps[i] = 0
+        self.prefix_cache_keys[i] = None
         for k in self.host_counters:
             self.host_counters[k][i] = 0
         if upload:
@@ -621,6 +628,54 @@ class FleetEngine:
         self.steps_run[i] = snap["steps_run"]
         for k in COUNTER_NAMES:
             self.host_counters[k][i] = snap["host_counters"][k]
+
+    def fork_element(self, i: int, snap: dict, cache_key: str | None = None) -> None:
+        """Fork batch position `i` from a shared-prefix snapshot: overlay
+        the snapshot's mid-run machine state (restore_element), then RESEED
+        the per-element traced inputs from the element's OWN effective
+        config — timing knobs and the FaultState schedule/seed/ECC
+        thresholds — while keeping the snapshot's TRAJECTORY state
+        (dead-core / dead-link / degrade masks, which record events that
+        already fired during the prefix).
+
+        The caller (sim.prefix) guarantees the snapshot's step count is at
+        or below the element's divergence point, so the inputs being
+        swapped in could not have influenced any state the snapshot
+        carries: the forked element is bit-exact with an unforked run.
+        Events with step < steps_run never re-fire (firing matches the
+        absolute step index), so resetting the schedule arrays wholesale
+        is safe. Call `replace_element(i, trace, override)` with the
+        element's workload first, exactly as for `restore_element`."""
+        from ..faults.schedule import fault_state_from_config
+        from .state import knobs_from_config
+
+        self.restore_element(i, snap)
+        ecfg = self.elem_cfgs[i]
+        fresh = fault_state_from_config(ecfg)
+        faults = jax.tree.map(lambda x: x[i], self.state.faults)._replace(
+            seed=fresh.seed,
+            ev_step=fresh.ev_step,
+            ev_kind=fresh.ev_kind,
+            ev_a=fresh.ev_a,
+            ev_b=fresh.ev_b,
+            flip_l1=fresh.flip_l1,
+            flip_llc=fresh.flip_llc,
+            due_rate=fresh.due_rate,
+        )
+        self.state = self.state._replace(
+            knobs=jax.tree.map(
+                lambda b, s: b.at[i].set(jnp.asarray(s)),
+                self.state.knobs,
+                knobs_from_config(ecfg),
+            ),
+            faults=jax.tree.map(
+                lambda b, s: b.at[i].set(jnp.asarray(s)),
+                self.state.faults,
+                faults,
+            ),
+        )
+        self.prefix_steps[i] = int(snap["steps_run"])
+        self.prefix_cache_keys[i] = cache_key
 
     def upload_events(self) -> None:
         """Push the host event array (mutated by splices) to the device.

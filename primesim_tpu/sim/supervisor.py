@@ -318,6 +318,19 @@ class RunSupervisor:
                 continue
             self.resumed_from = path
             self._log("resume", f"resumed from {os.path.basename(path)}")
+            # a forked run's snapshot is self-describing (format v6):
+            # surface the provenance in the audit trail so "this element
+            # never simulated steps 0..P itself" is on the record
+            pre = getattr(self.engine, "prefix_steps", None)
+            forked = (
+                int(np.asarray(pre).max()) if pre is not None else 0
+            )
+            if forked > 0:
+                self._log(
+                    "resume-prefix",
+                    f"restored state carries prefix-fork provenance "
+                    f"(max prefix_steps={forked})",
+                )
             return path
         raise CheckpointCorrupt(
             f"{self.store.dir}: all {len(snaps)} snapshots are corrupt"

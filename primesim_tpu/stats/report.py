@@ -139,6 +139,16 @@ def render_report(
                 f"  slowest chunk       {int(timeline['slowest_chunk_seq']):>16,}"
                 f"  ({float(timeline.get('slowest_chunk_wall_s', 0.0)) * 1e3:.1f} ms)"
             )
+        labels = timeline.get("labels") or {}
+        if "prefix" in labels:
+            # a prefix-forked campaign: show where the wall went so the
+            # shared-prefix win (or a cache hit's absent prefix) is visible
+            pre = labels["prefix"]
+            tail_wall = sum(
+                v.get("wall_s", 0.0) for k, v in labels.items() if k != "prefix"
+            )
+            add(f"  prefix wall seconds {float(pre.get('wall_s', 0.0)):>16.2f}")
+            add(f"  tail wall seconds   {tail_wall:>16.2f}")
     if resilience:
         add("")
         add("RESILIENCE")

@@ -1,0 +1,535 @@
+"""Prefix forking + on-disk warm-state cache (ISSUE 9, DESIGN.md §16).
+
+The contract: a sweep whose elements share (trace, timing knobs, ECC
+rates) and differ only in inputs that cannot influence the machine
+before the fault-schedule start pays for that shared prefix ONCE — a
+solo Engine runs it, the snapshot broadcasts into the fleet slots via
+`FleetEngine.fork_element`, and the forked campaign is BIT-EXACT with
+the unforked one (cycles, every counter, the full machine state
+including L1/directory arrays). A second identical campaign against a
+warm cache skips the prefix simulation entirely; a corrupt or tampered
+cache entry falls back to recompute; and a supervisor kill→resume of a
+forked run stays bit-exact (the checkpoint carries fork provenance).
+"""
+
+import dataclasses
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from primesim_tpu.config.machine import (
+    FAULT_LINK_DEGRADE,
+    MachineConfig,
+    small_test_config,
+)
+from primesim_tpu.sim.checkpoint import (
+    CheckpointCorrupt,
+    find_warm_states,
+    load_warm_state,
+    trace_fingerprint,
+    warm_key,
+)
+from primesim_tpu.sim.engine import Engine
+from primesim_tpu.sim.fleet import FleetEngine, apply_overrides
+from primesim_tpu.sim.prefix import (
+    NEVER,
+    dedup_plan,
+    execute_prefix_plan,
+    group_divergence,
+    plan_prefix,
+)
+from primesim_tpu.sim.supervisor import Preempted, RunSupervisor
+from primesim_tpu.trace import synth
+
+EV_STEP = 40  # fault-schedule start: the divergence point of a seed sweep
+CHUNK = 16
+PREFIX = EV_STEP // CHUNK * CHUNK  # chunk-floored fork point (32)
+
+
+def _chaos_cfg(**kw):
+    cfg = small_test_config(8, n_banks=4, quantum=200, **kw)
+    return dataclasses.replace(
+        cfg,
+        faults_enabled=True,
+        max_fault_events=1,
+        fault_events=((EV_STEP, FAULT_LINK_DEGRADE, 0, 3),),
+    )
+
+
+def _trace(seed=41):
+    return synth.fft_like(8, n_phases=2, points_per_core=12, seed=seed)
+
+
+def _seed_fleet(cfg, n=16, trace=None):
+    tr = trace if trace is not None else _trace()
+    ovs = [{"fault_seed": 100 + i} for i in range(n)]
+    return FleetEngine(cfg, [tr] * n, ovs, chunk_steps=CHUNK)
+
+
+def _assert_fleets_equal(a, b):
+    np.testing.assert_array_equal(a.cycles, b.cycles)
+    np.testing.assert_array_equal(a.steps_run, b.steps_run)
+    for k, v in a.counters.items():
+        np.testing.assert_array_equal(v, b.counters[k], err_msg=k)
+    for f in a.state._fields:
+        va, vb = getattr(a.state, f), getattr(b.state, f)
+        if hasattr(va, "_fields"):  # nested pytree (faults): leaf-wise
+            for sub in va._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(va, sub)),
+                    np.asarray(getattr(vb, sub)),
+                    err_msg=f"state field {f}.{sub}",
+                )
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(vb), err_msg=f"state field {f}"
+        )
+
+
+# ---- divergence analysis ---------------------------------------------------
+
+
+def test_chaos_seed_sweep_forks_at_schedule_start():
+    fleet = _seed_fleet(_chaos_cfg(), n=4)
+    groups = plan_prefix(fleet.elem_cfgs, fleet.traces, chunk_steps=CHUNK)
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.indices == [0, 1, 2, 3]
+    assert g.divergence == EV_STEP  # the fault-schedule start
+    assert g.prefix_steps == PREFIX  # chunk-floored below it
+
+
+def test_plan_classes_split_on_trace_knobs_and_live_seed():
+    cfg = _chaos_cfg()
+    base = _trace(41)
+    other = _trace(99)
+    traces = [base, base, other, other, base, base]
+    ovs = [
+        {"fault_seed": 1},
+        {"fault_seed": 2},
+        {"fault_seed": 3},
+        {"fault_seed": 4},
+        # knob overrides diverge at step 0: never grouped with the rest
+        {"fault_seed": 5, "dram_lat": 200},
+        {"fault_seed": 6, "llc_lat": 20},
+    ]
+    fleet = FleetEngine(cfg, traces, ovs, chunk_steps=CHUNK)
+    groups = plan_prefix(fleet.elem_cfgs, fleet.traces, chunk_steps=CHUNK)
+    assert [g.indices for g in groups] == [[0, 1], [2, 3]]
+
+    # nonzero flip rates make the seed live from step 0: seed-varying
+    # elements become singleton classes and nothing is forked
+    ecc = dataclasses.replace(cfg, fault_flip_l1=0.25)
+    fleet = _seed_fleet(ecc, n=4)
+    assert plan_prefix(fleet.elem_cfgs, fleet.traces, chunk_steps=CHUNK) == []
+
+    # mode off plans nothing; an integer mode caps the prefix
+    fleet = _seed_fleet(cfg, n=4)
+    assert plan_prefix(fleet.elem_cfgs, fleet.traces, mode="off") == []
+    capped = plan_prefix(
+        fleet.elem_cfgs, fleet.traces, mode="16", chunk_steps=CHUNK
+    )
+    assert capped[0].prefix_steps == 16
+
+
+def test_group_divergence_rules():
+    cfg = _chaos_cfg()
+    # fully identical configs never diverge (dedup's domain, not forking's)
+    assert group_divergence([cfg, cfg]) == NEVER
+    # seed-varying, rates zero: the fault-schedule start
+    a = dataclasses.replace(cfg, fault_seed=1)
+    b = dataclasses.replace(cfg, fault_seed=2)
+    assert group_divergence([a, b]) == EV_STEP
+    # schedules differing in a later event diverge at the non-common one
+    c = dataclasses.replace(
+        cfg,
+        max_fault_events=2,
+        fault_events=cfg.fault_events + ((77, FAULT_LINK_DEGRADE, 1, 2),),
+    )
+    assert group_divergence([cfg, c]) == 77
+
+
+def test_dedup_plan_detects_identical_elements():
+    cfg = _chaos_cfg()
+    tr = _trace()
+    cfgs = [
+        apply_overrides(cfg, {"fault_seed": 1}),
+        apply_overrides(cfg, {"fault_seed": 1}),
+        apply_overrides(cfg, {"fault_seed": 2}),
+    ]
+    keep, dup_of = dedup_plan(cfgs, [tr, tr, tr])
+    assert keep == [0, 2] and dup_of == {1: 0}
+    # a different trace with the same config is NOT a duplicate
+    keep, dup_of = dedup_plan(cfgs[:2], [tr, _trace(99)])
+    assert keep == [0, 1] and dup_of == {}
+
+
+# ---- fork-from-snapshot bit-exactness --------------------------------------
+
+
+def test_forked_seed_sweep_bit_exact_vs_unforked():
+    cfg = _chaos_cfg()
+    ref = _seed_fleet(cfg)
+    ref.run()
+    # the schedule must fire mid-run or the fixture proves nothing
+    assert int(ref.steps_run.max()) > EV_STEP
+
+    fleet = _seed_fleet(cfg)
+    groups = plan_prefix(fleet.elem_cfgs, fleet.traces, chunk_steps=CHUNK)
+    assert len(groups) == 1 and groups[0].indices == list(range(16))
+    st = execute_prefix_plan(fleet, groups)
+    assert st["forked_elements"] == 16
+    assert st["prefix_steps"] == PREFIX
+    assert list(fleet.prefix_steps) == [PREFIX] * 16
+    assert list(fleet.steps_run) == [PREFIX] * 16
+    fleet.run()
+    _assert_fleets_equal(fleet, ref)
+
+    # and against a solo Engine of one element's effective config:
+    # counters, cycles, and the L1/directory state arrays all match
+    solo = Engine(fleet.elem_cfgs[3], fleet.traces[3], chunk_steps=CHUNK)
+    solo.run()
+    np.testing.assert_array_equal(fleet.cycles[3], solo.cycles)
+    fc = fleet.element_counters(3)
+    for k, v in solo.counters.items():
+        np.testing.assert_array_equal(fc[k], v, err_msg=k)
+    es = fleet.element_state(3)
+    for f in ("l1", "dirm"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(es, f)),
+            np.asarray(getattr(solo.state, f)),
+            err_msg=f,
+        )
+
+
+def test_forked_mixed_groups_and_singletons_bit_exact():
+    # two prefix-sharing classes (different traces) plus a knob-override
+    # singleton that is NOT forked — all coexisting in one fleet
+    cfg = _chaos_cfg()
+    traces = [_trace(41), _trace(41), _trace(99), _trace(99), _trace(41)]
+    ovs = [
+        {"fault_seed": 1},
+        {"fault_seed": 2},
+        {"fault_seed": 3},
+        {"fault_seed": 4},
+        {"fault_seed": 5, "dram_lat": 250},
+    ]
+    ref = FleetEngine(cfg, traces, ovs, chunk_steps=CHUNK)
+    ref.run()
+
+    fleet = FleetEngine(cfg, traces, ovs, chunk_steps=CHUNK)
+    groups = plan_prefix(fleet.elem_cfgs, fleet.traces, chunk_steps=CHUNK)
+    assert [g.indices for g in groups] == [[0, 1], [2, 3]]
+    st = execute_prefix_plan(fleet, groups)
+    assert st["groups"] == 2 and st["forked_elements"] == 4
+    assert list(fleet.prefix_steps) == [PREFIX, PREFIX, PREFIX, PREFIX, 0]
+    fleet.run()
+    _assert_fleets_equal(fleet, ref)
+
+
+# ---- warm-state cache ------------------------------------------------------
+
+
+def _forked_fleet(cfg, root, rec=None, n=4):
+    fleet = _seed_fleet(cfg, n=n)
+    groups = plan_prefix(fleet.elem_cfgs, fleet.traces, chunk_steps=CHUNK)
+    st = execute_prefix_plan(
+        fleet, groups, warm_cache=True, cache_root=root, obs=rec
+    )
+    return fleet, st
+
+
+def test_warm_cache_hit_skips_prefix_simulation(tmp_path):
+    from primesim_tpu.obs import Recorder
+
+    cfg = _chaos_cfg()
+    root = str(tmp_path / "warm")
+
+    rec1 = Recorder("basic")
+    fleet1, st1 = _forked_fleet(cfg, root, rec1)
+    assert (st1["cache_hits"], st1["cache_misses"]) == (0, 1)
+    # the miss path simulated the prefix: obs saw prefix-labeled chunks
+    labels1 = rec1.store.summary()["labels"]
+    assert labels1["prefix"]["chunks"] == PREFIX // CHUNK
+
+    rec2 = Recorder("basic")
+    fleet2, st2 = _forked_fleet(cfg, root, rec2)
+    assert (st2["cache_hits"], st2["cache_misses"]) == (1, 0)
+    assert st2["prefix_wall_s"] == 0.0
+    # the hit path skipped the prefix ENTIRELY: zero prefix-labeled
+    # chunks ever reached the recorder
+    assert rec2.store.summary() is None
+
+    fleet1.run()
+    fleet2.run()
+    _assert_fleets_equal(fleet1, fleet2)
+
+    # the sidecar index finds the entry by config alone, deepest first
+    found = find_warm_states(root, fleet1.elem_cfgs[0],
+                             trace_fingerprint(fleet1.traces[0]))
+    assert found and found[0][0] == PREFIX
+
+
+def test_corrupt_cache_entry_falls_back_to_recompute(tmp_path):
+    cfg = _chaos_cfg()
+    root = str(tmp_path / "warm")
+    _, st1 = _forked_fleet(cfg, root)
+    assert st1["cache_misses"] == 1
+
+    ref = _seed_fleet(cfg, n=4)
+    ref.run()
+
+    # tear every cached npz in half: load must fail closed, the planner
+    # must recompute (and replace the entry), results must stay bit-exact
+    npzs = [p for p in os.listdir(root) if p.endswith(".npz")]
+    assert npzs
+    for p in npzs:
+        full = os.path.join(root, p)
+        blob = open(full, "rb").read()
+        with open(full, "wb") as f:
+            f.write(blob[: len(blob) // 2])
+    fleet2, st2 = _forked_fleet(cfg, root)
+    assert (st2["cache_hits"], st2["cache_misses"]) == (0, 1)
+    fleet2.run()
+    _assert_fleets_equal(fleet2, ref)
+
+    # the bad entry was overwritten: the next campaign hits
+    _, st3 = _forked_fleet(cfg, root)
+    assert st3["cache_hits"] == 1
+
+
+def test_warm_key_sensitivity():
+    cfg = _chaos_cfg()
+    tr = _trace()
+    fp = trace_fingerprint(tr)
+    k0 = warm_key(cfg, fp, PREFIX)
+
+    # trace change misses
+    assert warm_key(cfg, trace_fingerprint(_trace(99)), PREFIX) != k0
+    # geometry change misses (different LLC capacity)
+    geo = dataclasses.replace(
+        cfg, llc=dataclasses.replace(cfg.llc, size=cfg.llc.size * 2)
+    )
+    assert warm_key(geo, fp, PREFIX) != k0
+    # knob change misses (traced, but part of the warm payload)
+    assert warm_key(apply_overrides(cfg, {"dram_lat": 200}), fp, PREFIX) != k0
+    # step-count change misses
+    assert warm_key(cfg, fp, PREFIX + CHUNK) != k0
+    # seed change with all ECC rates zero HITS: the seed is
+    # architecturally unreachable before the schedule start
+    assert warm_key(dataclasses.replace(cfg, fault_seed=7), fp, PREFIX) == k0
+    # ... but with a nonzero flip rate the seed is live from step 0
+    ecc = dataclasses.replace(cfg, fault_flip_l1=0.25)
+    assert (
+        warm_key(dataclasses.replace(ecc, fault_seed=7), fp, PREFIX)
+        != warm_key(ecc, fp, PREFIX)
+    )
+    # events BELOW the prefix are pinned; an event at/after it is not
+    late = dataclasses.replace(
+        cfg, fault_events=((EV_STEP + 100, FAULT_LINK_DEGRADE, 0, 3),)
+    )
+    assert warm_key(late, fp, PREFIX) == warm_key(
+        dataclasses.replace(cfg, fault_events=()), fp, PREFIX
+    )
+
+
+def test_load_warm_state_rejects_mismatched_key(tmp_path):
+    cfg = _chaos_cfg()
+    root = str(tmp_path / "warm")
+    _forked_fleet(cfg, root)
+    fp = trace_fingerprint(_trace())
+    key = warm_key(cfg, fp, PREFIX)
+    # asking for the entry under a different effective config must fail
+    # closed (recomputed key mismatch), not silently serve wrong state
+    other = apply_overrides(cfg, {"dram_lat": 200})
+    with pytest.raises((CheckpointCorrupt, ValueError, FileNotFoundError)):
+        load_warm_state(root, key, other, fp, PREFIX)
+    # the honest request loads
+    snap = load_warm_state(root, key, cfg, fp, PREFIX)
+    assert int(snap["steps_run"]) == PREFIX
+
+
+# ---- supervisor compose ----------------------------------------------------
+
+
+def _kill_at(chunk):
+    def on_chunk(sup):
+        if sup.committed == chunk:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    return on_chunk
+
+
+def test_supervisor_resume_of_forked_run_bit_exact(tmp_path):
+    cfg = _chaos_cfg()
+
+    def forked(n=4):
+        fleet = _seed_fleet(cfg, n=n)
+        groups = plan_prefix(fleet.elem_cfgs, fleet.traces, chunk_steps=CHUNK)
+        execute_prefix_plan(fleet, groups)
+        return fleet
+
+    # uninterrupted supervised forked run = the reference cadence
+    ref = forked()
+    RunSupervisor(ref).run()
+
+    eng = forked()
+    sup = RunSupervisor(
+        eng, snapshot_dir=str(tmp_path), checkpoint_every_chunks=1,
+        on_chunk=_kill_at(2),
+    )
+    with pytest.raises(Preempted):
+        sup.run()
+    assert not eng.done()
+
+    # resume into a FRESH, UNFORKED fleet: the snapshot alone must carry
+    # everything (including the fork provenance, logged on resume)
+    eng2 = _seed_fleet(cfg, n=4)
+    sup2 = RunSupervisor(eng2, snapshot_dir=str(tmp_path))
+    assert sup2.resume() is not None
+    assert any("resume-prefix" in ln for ln in sup2.log_lines())
+    assert int(np.asarray(eng2.prefix_steps).max()) == PREFIX
+    sup2.run()
+    np.testing.assert_array_equal(eng2.cycles, ref.cycles)
+    for k, v in eng2.counters.items():
+        np.testing.assert_array_equal(v, ref.counters[k], err_msg=k)
+    _assert_fleets_equal(eng2, ref)
+
+
+# ---- CLI surface -----------------------------------------------------------
+
+
+def _write_cfg(tmp_path):
+    p = str(tmp_path / "m.json")
+    with open(p, "w") as f:
+        f.write(MachineConfig(n_cores=8, n_banks=8).to_json())
+    return p
+
+
+def _write_schedule(tmp_path):
+    p = str(tmp_path / "sched.json")
+    with open(p, "w") as f:
+        json.dump(
+            {"events": [{"step": EV_STEP, "kind": "link_degrade",
+                         "link": 0, "extra": 3}]},
+            f,
+        )
+    return p
+
+
+def _json_lines(capsys):
+    cap = capsys.readouterr()
+    return (
+        [json.loads(ln) for ln in cap.out.splitlines() if ln.startswith("{")],
+        cap.err,
+    )
+
+
+def _elem_lines(lines):
+    out = []
+    for d in lines:
+        if d["metric"] != "simulated_MIPS":
+            continue
+        det = dict(d["detail"])
+        det.pop("wall_s")
+        out.append(det)
+    return out
+
+
+def test_cli_sweep_fork_and_warm_cache(tmp_path, capsys, monkeypatch):
+    from primesim_tpu.cli import main
+
+    monkeypatch.setenv("PRIMETPU_CACHE_DIR", str(tmp_path / "cache"))
+    cfg = _write_cfg(tmp_path)
+    sched = _write_schedule(tmp_path)
+    argv = [
+        "sweep", cfg,
+        "--synth", "fft_like:n_phases=2,points_per_core=12",
+        "--fault-schedule", sched,
+        "--vary", "fault_seed=0",
+        "--vary", "fault_seed=1",
+        "--vary", "fault_seed=2",
+        "--vary", "fault_seed=3",
+        "--chunk-steps", "16",
+    ]
+    # unforked reference
+    assert main(argv) == 0
+    ref_lines, _ = _json_lines(capsys)
+    assert not any(d["metric"] == "prefix_fork" for d in ref_lines)
+
+    # forked + warm cache, cold: one miss, parity with unforked
+    assert main(argv + ["--fork-prefix", "auto", "--warm-cache", "on"]) == 0
+    l1, _ = _json_lines(capsys)
+    pf1 = [d for d in l1 if d["metric"] == "prefix_fork"][0]["detail"]
+    assert pf1["forked_elements"] == 4
+    assert (pf1["cache_hits"], pf1["cache_misses"]) == (0, 1)
+    assert _elem_lines(l1) == _elem_lines(ref_lines)
+
+    # second identical sweep: cache hit, NO prefix simulation, identical
+    # per-element results
+    assert main(argv + ["--fork-prefix", "auto", "--warm-cache", "on"]) == 0
+    l2, _ = _json_lines(capsys)
+    pf2 = [d for d in l2 if d["metric"] == "prefix_fork"][0]["detail"]
+    assert (pf2["cache_hits"], pf2["cache_misses"]) == (1, 0)
+    assert pf2["prefix_wall_s"] == 0.0
+    assert _elem_lines(l2) == _elem_lines(ref_lines)
+
+
+def test_cli_sweep_dedup_fans_out(tmp_path, capsys):
+    from primesim_tpu.cli import main
+
+    cfg = _write_cfg(tmp_path)
+    rc = main(
+        [
+            "sweep", cfg,
+            "--synth", "false_sharing:n_mem_ops=30",
+            "--vary", "llc_lat=12",
+            "--vary", "llc_lat=12",
+            "--vary", "llc_lat=30",
+            "--chunk-steps", "16",
+        ]
+    )
+    assert rc == 0
+    lines, err = _json_lines(capsys)
+    assert "deduplicated 1 identical element(s)" in err
+    elems = {d["detail"]["fleet_index"]: d["detail"]
+             for d in lines if d["metric"] == "simulated_MIPS"}
+    assert elems[1]["dedup_of"] == 0
+    assert elems[1]["instructions"] == elems[0]["instructions"]
+    assert elems[1]["max_core_cycles"] == elems[0]["max_core_cycles"]
+    assert "dedup_of" not in elems[2]
+    agg = [d for d in lines if d["metric"] == "fleet_aggregate_MIPS"][0]
+    assert agg["detail"]["deduplicated"] == [1]
+    # duplicates don't double-count the aggregate
+    assert agg["detail"]["instructions"] == (
+        elems[0]["instructions"] + elems[2]["instructions"]
+    )
+
+
+def test_cli_vary_errors_are_structured_exit_2(tmp_path, capsys):
+    from primesim_tpu.cli import main
+
+    cfg = _write_cfg(tmp_path)
+    base = ["sweep", cfg, "--synth", "fft_like:n_phases=2"]
+
+    # integer-parse failure lists the valid knob keys and exits 2 with
+    # the structured {"error": ...} JSON (the typed-error contract)
+    rc = main(base + ["--vary", "dram_lat=abc"])
+    assert rc == 2
+    _, err = _json_lines(capsys)
+    line = [ln for ln in err.splitlines() if ln.startswith("{")][-1]
+    obj = json.loads(line)["error"]
+    assert obj["type"] == "VarySpecError"
+    assert "fault_seed" in obj["detail"]  # the valid-keys listing
+    assert obj["location"] == {"pair": "dram_lat=abc"}
+
+    rc = main(base + ["--vary", "bogus=3"])
+    assert rc == 2
+    _, err = _json_lines(capsys)
+    line = [ln for ln in err.splitlines() if ln.startswith("{")][-1]
+    obj = json.loads(line)["error"]
+    assert obj["type"] == "VarySpecError"
+    assert "bogus" in obj["detail"] and "quantum" in obj["detail"]
