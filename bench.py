@@ -392,6 +392,59 @@ def main() -> None:
         }
 
     # LIVE per-phase cuts (scripts/prof/prof_phase.py source surgery) on
+    # elastic pool scaling (DESIGN.md §17): the same 16-element campaign
+    # through `sweep --workers 1` vs `--workers 3` — real worker
+    # processes over the unix socket, so the measurement prices the
+    # whole protocol (lease RPCs, heartbeats, per-chunk checkpoint
+    # fsyncs, per-worker JIT compile) against the parallelism it buys.
+    # Advisory at 1.5x (never hard: the ratio collapses on starved CI
+    # runners where 3 workers share 2 cores). PRIMETPU_BENCH_POOL=0
+    # skips (metric reports null).
+    pool_detail = None
+    pool_gate = None
+    if os.environ.get("PRIMETPU_BENCH_POOL", "1") != "0":
+        import subprocess
+        import tempfile
+
+        from primesim_tpu.config.machine import small_test_config
+
+        pool_tmp = tempfile.mkdtemp(prefix="primetpu-bench-pool-")
+        pool_cfg_path = os.path.join(pool_tmp, "cfg.json")
+        with open(pool_cfg_path, "w") as f:
+            f.write(small_test_config(4).to_json())
+        pool_cmd = [
+            sys.executable, "-m", "primesim_tpu.cli", "sweep",
+            pool_cfg_path, "--synth",
+            "fft_like:n_phases=2,points_per_core=64,ins_per_mem=4,seed=5",
+            "--chunk-steps", "64",
+        ]
+        for i in range(16):
+            pool_cmd += ["--vary", f"llc_lat={8 + i}"]
+
+        def _pool_campaign(workers: int) -> float:
+            t0 = time.perf_counter()
+            subprocess.run(
+                pool_cmd + ["--workers", str(workers)],
+                check=True, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            return time.perf_counter() - t0
+
+        pool_wall_1 = _pool_campaign(1)
+        pool_wall_3 = _pool_campaign(3)
+        pool_speedup = pool_wall_1 / pool_wall_3
+        pool_detail = {
+            "elements": 16,
+            "wall_s_workers1": round(pool_wall_1, 3),
+            "wall_s_workers3": round(pool_wall_3, 3),
+            "speedup_x": round(pool_speedup, 3),
+        }
+        pool_gate = {
+            "floor_x": 1.5,
+            "hard": False,
+            "passed": bool(pool_speedup >= 1.5),
+        }
+
     # the headline machine: cumulative ms/step at each phase marker, so
     # every bench artifact carries the serial-chain decomposition next to
     # the static r5 record. PRIMETPU_BENCH_PHASE_CUTS=0 skips (each cut
@@ -443,6 +496,12 @@ def main() -> None:
                     "sweep_fork_speedup": (
                         fork_detail["speedup_x"] if fork_detail else None
                     ),
+                    # the same campaign through 1 vs 3 real worker
+                    # processes (null when PRIMETPU_BENCH_POOL=0;
+                    # advisory gate >= 1.5x)
+                    "pool_sweep_speedup": (
+                        pool_detail["speedup_x"] if pool_detail else None
+                    ),
                 },
                 "detail": {
                     "n_cores": C,
@@ -482,6 +541,11 @@ def main() -> None:
                     # PRIMETPU_BENCH_FORK=0)
                     "sweep_fork": fork_detail,
                     "sweep_fork_gate": fork_gate,
+                    # elastic pool campaign economics (DESIGN.md §17):
+                    # 16 units through 1 vs 3 worker processes (null
+                    # when PRIMETPU_BENCH_POOL=0)
+                    "pool_sweep": pool_detail,
+                    "pool_sweep_gate": pool_gate,
                     # STATIC RECORD: round-5 restructure evidence measured
                     # on TPU 2026-07-30 (scripts/prof/prof_phase.py
                     # cumulative cuts / prof_bisect.py ablations,

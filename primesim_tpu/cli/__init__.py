@@ -539,6 +539,17 @@ def cmd_sweep(ns) -> int:
             ) from None
     cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
     _check_supervision_flags(ns)
+    if ns.workers:
+        # elastic pool path (DESIGN.md §17): coordinator in-process, N
+        # worker subprocesses leasing units over the serve protocol
+        from ..pool.campaign import run_pooled_sweep
+
+        return run_pooled_sweep(ns, cfg)
+    if ns.report:
+        raise SystemExit(
+            "sweep: --report is the pooled campaign report (--workers); "
+            "use --report-dir for per-element reports"
+        )
     from ..trace.format import Trace, TraceError, fold_ins
 
     # per-element SOURCES: callables for file loads (so an unreadable
@@ -875,6 +886,23 @@ def cmd_sweep(ns) -> int:
         )
         return 3
     return 0
+
+
+def cmd_worker(ns) -> int:
+    """Pool worker process (DESIGN.md §17): lease work units from a
+    `sweep --workers` coordinator, simulate them under per-unit element
+    checkpoints + heartbeats, ack results. Normally spawned BY the
+    coordinator; running one by hand joins an in-flight campaign (that
+    is the elastic part)."""
+    from ..pool.worker import run_worker
+
+    return run_worker(
+        ns.connect,
+        ns.worker_id,
+        warm_cache=ns.warm_cache == "on",
+        reconnect_timeout_s=ns.reconnect_timeout,
+        crash_after_chunks=ns.crash_after_chunks,
+    )
 
 
 def cmd_synth(ns) -> int:
@@ -1297,10 +1325,68 @@ def build_parser() -> argparse.ArgumentParser:
              "(unreadable trace, bad overrides) aborts the whole sweep "
              "instead of being quarantined into its own JSON line",
     )
+    w.add_argument(
+        "--workers", type=int, default=0, metavar="N",
+        help="run the sweep as an elastic pooled campaign: a lease-based "
+             "coordinator plus N worker processes; a crashed/OOM-killed "
+             "worker's units re-dispatch and resume from their last "
+             "checkpoint (DESIGN.md §17)",
+    )
+    w.add_argument(
+        "--pool-dir", default=None, metavar="DIR",
+        help="(--workers) lease ledger + per-unit checkpoints live here; "
+             "restarting a killed campaign with the same DIR resumes it "
+             "(default: a throwaway temp dir)",
+    )
+    w.add_argument(
+        "--lease-ttl", type=float, default=10.0, metavar="SEC",
+        help="(--workers) lease deadline; a worker missing heartbeats "
+             "this long is presumed dead and its unit re-dispatches "
+             "(default 10)",
+    )
+    w.add_argument(
+        "--poison-threshold", type=int, default=2, metavar="K",
+        help="(--workers) quarantine a unit after its lease expired "
+             "under K DISTINCT workers (default 2)",
+    )
+    w.add_argument(
+        "--hedge", choices=("on", "off"), default="on",
+        help="(--workers) near campaign end, speculatively re-dispatch "
+             "the slowest in-flight unit to an idle worker; first ack "
+             "wins (default on)",
+    )
+    w.add_argument(
+        "--report", metavar="PATH",
+        help="(--workers) write a text report with the POOL section",
+    )
     _add_resilience_flags(w)
     _add_fault_flags(w)
     _add_obs_flags(w)
     w.set_defaults(fn=cmd_sweep)
+
+    k = sub.add_parser(
+        "worker",
+        help="pool worker: lease sweep work units from a `sweep "
+             "--workers` coordinator socket (normally spawned by it; "
+             "run by hand to elastically join a campaign)",
+    )
+    k.add_argument("--connect", required=True, metavar="SOCK",
+                   help="coordinator unix socket path")
+    k.add_argument("--worker-id", required=True, metavar="ID")
+    k.add_argument(
+        "--warm-cache", choices=("on", "off"), default="off",
+        help="consult the on-disk warm-state cache for fresh units",
+    )
+    k.add_argument(
+        "--reconnect-timeout", type=float, default=60.0, metavar="SEC",
+        help="give up (exit 75) after the coordinator has been "
+             "unreachable this long",
+    )
+    k.add_argument(
+        "--crash-after-chunks", type=int, default=None,
+        help=argparse.SUPPRESS,  # chaos-test hook: SIGKILL self at chunk N
+    )
+    k.set_defaults(fn=cmd_worker)
 
     c = sub.add_parser(
         "capture",

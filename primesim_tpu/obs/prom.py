@@ -127,3 +127,59 @@ def render_prometheus(sched, journal=None, draining=False,
                    recovered.get("jobs_requeued", 0))])
 
     return d.render()
+
+
+def render_pool_prometheus(coord) -> str:
+    """Scrape payload for a live pool coordinator (`metrics` verb on the
+    pool socket — `primetpu serve-status --metrics` works against it)."""
+    s = coord.stats()
+    d = _Doc()
+
+    d.metric("primetpu_pool_units", "gauge",
+             "Work units by lease-lifecycle state.",
+             [({"state": st}, n) for st, n in sorted(s["units"].items())])
+    d.metric("primetpu_pool_leases_active", "gauge",
+             "Leases currently held by workers (hedges count twice).",
+             [(None, s["leases_active"])])
+    d.metric("primetpu_pool_workers_seen", "gauge",
+             "Distinct worker ids that have ever requested a lease.",
+             [(None, len(s["workers_seen"]))])
+    c = s["counters"]
+    d.metric("primetpu_pool_leases_total", "counter",
+             "Leases granted since campaign start.",
+             [(None, c["leases"])])
+    d.metric("primetpu_pool_expired_total", "counter",
+             "Leases expired for missed heartbeats (presumed-dead "
+             "workers).", [(None, c["expired"])])
+    d.metric("primetpu_pool_redispatches_total", "counter",
+             "Units re-dispatched after a lease expiry.",
+             [(None, c["redispatches"])])
+    d.metric("primetpu_pool_hedges_total", "counter",
+             "Speculative straggler re-dispatches (first-ACK-wins).",
+             [(None, c["hedges"])])
+    d.metric("primetpu_pool_acks_total", "counter",
+             "Unit results accepted.", [(None, c["acks"])])
+    d.metric("primetpu_pool_duplicate_acks_total", "counter",
+             "Acks discarded because another attempt already won.",
+             [(None, c["duplicates"])])
+    d.metric("primetpu_pool_poisoned_total", "counter",
+             "Units quarantined after killing distinct workers.",
+             [(None, c["poisoned"])])
+    d.metric("primetpu_pool_heartbeats_total", "counter",
+             "Heartbeats received.", [(None, c["heartbeats"])])
+    d.metric("primetpu_pool_done", "gauge",
+             "1 when every unit is DONE or POISON.",
+             [(None, 1 if s["done"] else 0)])
+
+    journal = getattr(coord, "journal", None)
+    if journal is not None:
+        d.metric("primetpu_journal_appends_total", "counter",
+                 "Ledger records fsynced since campaign start.",
+                 [(None, journal.appended)])
+        fsync = getattr(journal, "fsync_hist", None)
+        if fsync is not None:
+            d.histogram("primetpu_journal_fsync_seconds",
+                        "Wall time of each ledger write+flush+fsync.",
+                        fsync)
+
+    return d.render()

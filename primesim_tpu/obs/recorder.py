@@ -104,6 +104,16 @@ class Recorder:
                 "prefix", kind, {k: str(v) for k, v in args.items()}
             )
 
+    def pool_event(self, kind, **args) -> None:
+        """Elastic-pool instant (lease, heartbeat, expire, redispatch,
+        hedge, ack, duplicate, poison) on the ``pool`` track — the
+        TIMELINE's evidence of every lease-protocol decision, and what
+        the chaos tests assert redispatch visibility against."""
+        if self.trace is not None:
+            self.trace.instant(
+                "pool", kind, {k: str(v) for k, v in args.items()}
+            )
+
     # ---- output ----------------------------------------------------------
 
     def timeline_summary(self):

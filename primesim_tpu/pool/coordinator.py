@@ -1,0 +1,458 @@
+"""Pool coordinator — lease-based work distribution (DESIGN.md §17).
+
+The coordinator owns the campaign: a table of work units, a durable
+ledger (the serve `JobJournal` reused verbatim), and a unix socket
+speaking the same JSON-lines protocol as `primetpu serve`. Workers are
+peers that PULL:
+
+    lease      {worker}                      -> {unit, epoch, checkpoint?}
+                                              | {idle, retry_after_s}
+                                              | {done: true}
+    heartbeat  {worker, unit_id, epoch, steps} -> {ok} | {lost: true}
+    ack        {worker, unit_id, epoch, key, result, resumed_steps}
+                                             -> {accepted} | {duplicate}
+    status     {}                            -> campaign stats
+    metrics    {}                            -> Prometheus text
+
+Lease discipline: a grant carries an `epoch` (monotonic per unit) and a
+deadline `lease_ttl_s` ahead; heartbeats renew it. A worker that stops
+heartbeating — crashed, OOM-killed, wedged — has its lease EXPIRE, which
+journals the kill evidence and returns the unit to PENDING for
+re-dispatch, where the next worker resumes from the unit's last element
+checkpoint. Expiry is the only failure detector: the coordinator never
+watches pids, so workers may live anywhere the socket reaches.
+
+Safety: a unit whose leases expired under `poison_threshold` DISTINCT
+workers is quarantined as poison (it is killing whoever touches it) and
+the campaign proceeds without it. Liveness: first-ACK-wins — an ack is
+accepted even from an expired epoch, because units are deterministic, so
+a "lost" worker that was merely slow still contributes its result.
+
+Hedging: when PENDING runs dry but leases remain in flight, a lease
+request is answered with a SPECULATIVE twin of the oldest single-leased
+unit (epoch bumped). First ack wins; the loser's ack folds away as a
+duplicate. This bounds campaign tail latency by a straggler's margin
+over the second-slowest worker rather than by the straggler itself.
+"""
+
+from __future__ import annotations
+
+import os
+import socketserver
+import threading
+import time
+
+from ..serve.journal import JobJournal
+from ..serve.protocol import claim_socket_path, encode, error_obj, read_line
+from . import units as U
+
+
+class PoolCoordinator:
+    def __init__(
+        self,
+        units: list[dict],
+        pool_dir: str,
+        socket_path: str | None = None,
+        lease_ttl_s: float = 10.0,
+        poison_threshold: int = U.DEFAULT_POISON_THRESHOLD,
+        hedge: bool = True,
+        obs=None,
+        clock=time.monotonic,
+    ):
+        self.pool_dir = str(pool_dir)
+        os.makedirs(os.path.join(self.pool_dir, "units"), exist_ok=True)
+        self.socket_path = socket_path or os.path.join(
+            self.pool_dir, "pool.sock"
+        )
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.poison_threshold = int(poison_threshold)
+        self.hedge_enabled = bool(hedge)
+        self.obs = obs
+        self.clock = clock
+        self.journal = JobJournal(self.pool_dir)
+        self.journal.obs = obs
+
+        self._lock = threading.Lock()
+        # unit_id -> mutable coordinator state wrapped around the spec
+        self.units: dict[str, dict] = {}
+        for spec in units:
+            self.units[spec["unit_id"]] = {
+                "spec": spec,
+                "state": U.PENDING,
+                "epoch": 0,
+                # worker -> {epoch, deadline, granted, steps, hedge}
+                "leases": {},
+                "kills": set(),
+                "result": None,
+                "resumed_steps": 0,
+            }
+        self.workers_seen: set[str] = set()
+        self.counters = {
+            "leases": 0, "expired": 0, "redispatches": 0, "hedges": 0,
+            "acks": 0, "duplicates": 0, "poisoned": 0, "heartbeats": 0,
+        }
+        self.recovered = self._recover()
+        self._srv = None
+
+    # ---- restart recovery ------------------------------------------------
+
+    def _recover(self) -> dict:
+        """Replay the pool ledger: adopt journaled results (matching unit
+        key only — a changed campaign definition must not inherit stale
+        results), poison marks, and kill evidence. Unfinished units go
+        back to PENDING; their in-flight workers re-adopt their leases on
+        the next heartbeat (see `_h_heartbeat`)."""
+        records, dropped = self.journal.replay()
+        folded, clean = U.fold_unit_records(records)
+        adopted = stale = 0
+        for unit_id, f in folded.items():
+            u = self.units.get(unit_id)
+            if u is None:
+                stale += 1
+                continue
+            if f["key"] is not None and f["key"] != u["spec"]["key"]:
+                stale += 1  # ledger describes a different campaign
+                continue
+            u["epoch"] = max(u["epoch"], f["max_epoch"])
+            u["kills"] |= f["kills"]
+            if f["result"] is not None:
+                u["state"] = U.DONE
+                u["result"] = f["result"]
+                u["resumed_steps"] = f["resumed_steps"]
+                adopted += 1
+            elif f["poison"]:
+                u["state"] = U.POISON
+        stats = {
+            "ledger_records": len(records),
+            "torn_tail_dropped": dropped,
+            "results_adopted": adopted,
+            "stale_entries": stale,
+            "clean_drain": clean,
+        }
+        if records:
+            self.journal.note(f"pool recovered: {stats}")
+        return stats
+
+    # ---- lease bookkeeping (call with self._lock held) -------------------
+
+    def _expire_stale(self) -> None:
+        now = self.clock()
+        for unit_id, u in self.units.items():
+            if u["state"] != U.LEASED:
+                continue
+            for worker in [w for w, l in u["leases"].items()
+                           if l["deadline"] < now]:
+                lease = u["leases"].pop(worker)
+                u["kills"].add(worker)
+                self.counters["expired"] += 1
+                self.journal.append({
+                    "t": "expire", "unit_id": unit_id, "worker": worker,
+                    "epoch": lease["epoch"],
+                })
+                self._pool_event("expire", unit=unit_id, worker=worker,
+                                 epoch=lease["epoch"])
+            if not u["leases"]:
+                if len(u["kills"]) >= self.poison_threshold:
+                    u["state"] = U.POISON
+                    self.counters["poisoned"] += 1
+                    self.journal.append({
+                        "t": "poison", "unit_id": unit_id,
+                        "key": u["spec"]["key"],
+                        "kills": sorted(u["kills"]),
+                    })
+                    self._pool_event("poison", unit=unit_id,
+                                     kills=len(u["kills"]))
+                else:
+                    u["state"] = U.PENDING  # re-dispatch on next lease
+
+    def _checkpoint_rel(self, unit_id: str) -> str | None:
+        rel = os.path.join("units", f"{unit_id}.npz")
+        if os.path.exists(os.path.join(self.pool_dir, rel)):
+            return rel
+        return None
+
+    def _grant(self, u: dict, worker: str, hedge: bool) -> dict:
+        unit_id = u["spec"]["unit_id"]
+        u["epoch"] += 1
+        u["state"] = U.LEASED
+        redispatch = bool(u["kills"]) and not hedge
+        u["leases"][worker] = {
+            "epoch": u["epoch"],
+            "deadline": self.clock() + self.lease_ttl_s,
+            "granted": self.clock(),
+            "steps": 0,
+            "hedge": hedge,
+        }
+        self.counters["leases"] += 1
+        if hedge:
+            self.counters["hedges"] += 1
+        if redispatch:
+            self.counters["redispatches"] += 1
+        self.journal.append({
+            "t": "lease", "unit_id": unit_id, "worker": worker,
+            "epoch": u["epoch"], "key": u["spec"]["key"],
+            "hedge": hedge,
+        })
+        self._pool_event(
+            "hedge" if hedge else ("redispatch" if redispatch else "lease"),
+            unit=unit_id, worker=worker, epoch=u["epoch"],
+        )
+        return {
+            "ok": True,
+            "unit": u["spec"],
+            "epoch": u["epoch"],
+            "lease_ttl_s": self.lease_ttl_s,
+            "checkpoint": self._checkpoint_rel(unit_id),
+            "pool_dir": self.pool_dir,
+            "hedge": hedge,
+        }
+
+    def _hedge_candidate(self, worker: str) -> dict | None:
+        """Oldest single-leased in-flight unit not already held by this
+        worker — the straggler most worth a speculative twin."""
+        best = None
+        for u in self.units.values():
+            if u["state"] != U.LEASED or worker in u["leases"]:
+                continue
+            if len(u["leases"]) != 1:
+                continue  # one hedge twin at a time
+            granted = min(l["granted"] for l in u["leases"].values())
+            if best is None or granted < best[0]:
+                best = (granted, u)
+        return best[1] if best else None
+
+    # ---- verb handlers ---------------------------------------------------
+
+    def handle(self, req: dict) -> dict:
+        verb = req.get("verb")
+        try:
+            if verb == "metrics":
+                # rendered OUTSIDE the lock: render_pool_prometheus
+                # calls stats(), which takes it (non-reentrant)
+                from ..obs.prom import render_pool_prometheus
+
+                return {
+                    "ok": True,
+                    "content_type": "text/plain; version=0.0.4",
+                    "text": render_pool_prometheus(self),
+                }
+            with self._lock:
+                if verb == "lease":
+                    return self._h_lease(req)
+                if verb == "heartbeat":
+                    return self._h_heartbeat(req)
+                if verb == "ack":
+                    return self._h_ack(req)
+                if verb == "status":
+                    return {"ok": True, **self._stats()}
+                raise ValueError(f"unknown verb {verb!r}")
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            return {"ok": False, **error_obj(e)}
+
+    def _h_lease(self, req: dict) -> dict:
+        worker = str(req.get("worker", "anon"))
+        self.workers_seen.add(worker)
+        self._expire_stale()
+        pending = [u for u in self.units.values() if u["state"] == U.PENDING]
+        if pending:
+            u = min(pending, key=lambda u: u["spec"]["index"])
+            return self._grant(u, worker, hedge=False)
+        if self.done:
+            return {"ok": True, "done": True}
+        if self.hedge_enabled:
+            u = self._hedge_candidate(worker)
+            if u is not None:
+                return self._grant(u, worker, hedge=True)
+        return {"ok": True, "idle": True,
+                "retry_after_s": max(0.2, self.lease_ttl_s / 5.0)}
+
+    def _h_heartbeat(self, req: dict) -> dict:
+        worker = str(req.get("worker", "anon"))
+        unit_id = str(req.get("unit_id", ""))
+        epoch = int(req.get("epoch", 0))
+        self.counters["heartbeats"] += 1
+        u = self.units.get(unit_id)
+        if u is None or u["state"] in (U.DONE, U.POISON):
+            return {"ok": True, "lost": True}
+        lease = u["leases"].get(worker)
+        if lease is None and u["state"] == U.PENDING and epoch == u["epoch"]:
+            # graceful coordinator restart: the worker outlived us and is
+            # still simulating the current epoch — re-adopt its lease
+            # rather than wastefully re-dispatching the unit
+            u["state"] = U.LEASED
+            lease = u["leases"][worker] = {
+                "epoch": epoch, "granted": self.clock(),
+                "deadline": 0.0, "steps": 0, "hedge": False,
+            }
+            self.workers_seen.add(worker)
+            self._pool_event("readopt", unit=unit_id, worker=worker,
+                             epoch=epoch)
+        if lease is None or lease["epoch"] != epoch:
+            return {"ok": True, "lost": True}  # expired or superseded
+        lease["deadline"] = self.clock() + self.lease_ttl_s
+        lease["steps"] = int(req.get("steps", lease["steps"]))
+        self._pool_event("heartbeat", unit=unit_id, worker=worker,
+                         epoch=epoch, steps=lease["steps"])
+        return {"ok": True, "lease_ttl_s": self.lease_ttl_s}
+
+    def _h_ack(self, req: dict) -> dict:
+        worker = str(req.get("worker", "anon"))
+        unit_id = str(req.get("unit_id", ""))
+        epoch = int(req.get("epoch", 0))
+        u = self.units.get(unit_id)
+        if u is None:
+            raise KeyError(f"unknown unit {unit_id!r}")
+        if str(req.get("key", "")) != u["spec"]["key"]:
+            raise ValueError(
+                f"{unit_id}: ack key mismatch (campaign changed under "
+                "the worker?)"
+            )
+        if u["state"] == U.DONE:
+            # the losing half of a hedged pair, or a redelivery after a
+            # lost ack reply — discard, first ACK already won
+            self.counters["duplicates"] += 1
+            self._pool_event("duplicate", unit=unit_id, worker=worker,
+                             epoch=epoch)
+            return {"ok": True, "accepted": False, "duplicate": True}
+        # first-ACK-wins: accept even from an expired epoch — the unit is
+        # deterministic, a slow-but-alive "lost" worker's result is the
+        # same result
+        result = req.get("result")
+        resumed = int(req.get("resumed_steps", 0))
+        self.journal.append({
+            "t": "ack", "unit_id": unit_id, "worker": worker,
+            "epoch": epoch, "key": u["spec"]["key"], "result": result,
+            "resumed_steps": resumed,
+        })
+        u["state"] = U.DONE
+        u["result"] = result
+        u["resumed_steps"] = resumed
+        u["leases"].clear()
+        self.counters["acks"] += 1
+        self._pool_event("ack", unit=unit_id, worker=worker, epoch=epoch,
+                         resumed_steps=resumed)
+        # unit checkpoint is dead weight once the result is durable
+        rel = self._checkpoint_rel(unit_id)
+        if rel:
+            try:
+                os.unlink(os.path.join(self.pool_dir, rel))
+            except OSError:
+                pass
+        return {"ok": True, "accepted": True}
+
+    # ---- campaign state --------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return all(
+            u["state"] in (U.DONE, U.POISON) for u in self.units.values()
+        )
+
+    def results(self) -> list[dict]:
+        """Per-unit outcomes in index order (poisoned units carry
+        result=None plus their kill evidence)."""
+        out = []
+        for u in sorted(self.units.values(),
+                        key=lambda u: u["spec"]["index"]):
+            out.append({
+                "unit_id": u["spec"]["unit_id"],
+                "index": u["spec"]["index"],
+                "state": u["state"],
+                "result": u["result"],
+                "resumed_steps": u["resumed_steps"],
+                "kills": sorted(u["kills"]),
+            })
+        return out
+
+    def _stats(self) -> dict:
+        states = {s: 0 for s in (U.PENDING, U.LEASED, U.DONE, U.POISON)}
+        leases_active = 0
+        for u in self.units.values():
+            states[u["state"]] += 1
+            leases_active += len(u["leases"])
+        return {
+            "units": states,
+            "leases_active": leases_active,
+            "workers_seen": sorted(self.workers_seen),
+            "counters": dict(self.counters),
+            "recovered": self.recovered,
+            "done": self.done,
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return self._stats()
+
+    def pool_report(self) -> dict:
+        """POOL section payload for stats.report.render_report."""
+        s = self.stats()
+        return {
+            "units_total": len(self.units),
+            "units_done": s["units"][U.DONE],
+            "units_poisoned": s["units"][U.POISON],
+            "workers_seen": len(s["workers_seen"]),
+            "redispatches": s["counters"]["redispatches"],
+            "expired_leases": s["counters"]["expired"],
+            "hedges": s["counters"]["hedges"],
+            "duplicate_acks": s["counters"]["duplicates"],
+            "heartbeats": s["counters"]["heartbeats"],
+        }
+
+    def _pool_event(self, kind: str, **args) -> None:
+        if self.obs is not None:
+            self.obs.pool_event(kind, **args)
+
+    # ---- socket front door -----------------------------------------------
+
+    def start(self):
+        """Bind the pool socket and serve verbs from daemon threads.
+        Handlers take self._lock per request, so no inbox/main-loop dance
+        is needed — the coordinator never simulates, it only bookkeeps."""
+        coord = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        req = read_line(self.rfile)
+                    except ValueError as e:
+                        self.wfile.write(encode({"ok": False,
+                                                 **error_obj(e)}))
+                        return
+                    if req is None:
+                        return
+                    try:
+                        self.wfile.write(encode(coord.handle(req)))
+                        self.wfile.flush()
+                    except (BrokenPipeError, ValueError):
+                        return
+
+        class Listener(socketserver.ThreadingMixIn,
+                       socketserver.UnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        claim_socket_path(self.socket_path)
+        self._srv = Listener(self.socket_path, Handler)
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+        return self._srv
+
+    def tick(self) -> None:
+        """Periodic housekeeping from the campaign loop: expire leases
+        whose heartbeats stopped."""
+        with self._lock:
+            self._expire_stale()
+
+    def close(self, drained: bool = False) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        if drained:
+            self.journal.drain()
+        self.journal.close()

@@ -1,0 +1,156 @@
+"""Work units — the pool's unit of dispatch (DESIGN.md §17).
+
+A sweep campaign decomposes into one work unit per fleet element: a
+self-contained, SERIALIZABLE description (effective config JSON, trace
+path or synth spec, timing overrides, step budgets) that any worker
+process can materialize deterministically — the same property
+`serve.scheduler.materialize_workload` gives the daemon, which is what
+makes re-dispatch after a worker crash bit-exact: re-running a unit from
+its spec (or from its last element checkpoint) yields the identical
+simulation.
+
+The coordinator's durable state is a `serve.journal.JobJournal` in the
+pool directory, holding pool record types:
+
+    lease   {unit_id, worker, epoch, key, hedge}
+    expire  {unit_id, worker, epoch}          (missed heartbeat)
+    ack     {unit_id, worker, epoch, key, result, resumed_steps}
+    poison  {unit_id, key, kills}
+    note    {msg}                              (operator annotations)
+    drain   {}                                 (campaign completed)
+
+`fold_unit_records` rebuilds the restart state with the same invariants
+as serve's `fold_records`: duplicate-tolerant and first-ACK-wins — the
+first `ack` for a unit is authoritative; later acks (the losing half of
+a hedged pair, or a redelivery) are discarded. Expire records survive
+the fold so poison counting spans coordinator restarts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+#: a unit whose lease expired under K DISTINCT workers is poison — the
+#: fleet-level analogue of build_fleet_isolated's element quarantine
+DEFAULT_POISON_THRESHOLD = 2
+
+# unit lifecycle states (coordinator-side)
+PENDING = "PENDING"
+LEASED = "LEASED"
+DONE = "DONE"
+POISON = "POISON"
+
+
+def unit_key(unit: dict) -> str:
+    """Content address of a unit's WORKLOAD identity (not its id): the
+    ledger stamps every lease/ack with it so a restarted coordinator
+    rejects replayed results whose campaign definition changed."""
+    payload = {
+        k: unit.get(k)
+        for k in ("index", "config", "trace_path", "synth", "fold",
+                  "overrides", "chunk_steps", "max_steps")
+    }
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def build_units(
+    cfg,
+    trace_paths: list[str],
+    synth_specs: list[str],
+    overrides: list[dict],
+    fold: bool,
+    chunk_steps: int,
+    max_steps: int,
+    warm_cache: bool = False,
+) -> list[dict]:
+    """Decompose a sweep (the CLI's fan rule output: sources and
+    overrides already paired 1:1) into per-element work units. Trace
+    sources travel by PATH and synth sources by SPEC — workers
+    materialize them locally (traces never cross the wire)."""
+    sources: list[tuple[str, str]] = [("trace_path", p) for p in trace_paths]
+    sources += [("synth", s) for s in synth_specs]
+    if len(sources) != len(overrides):
+        raise ValueError(
+            f"{len(sources)} sources vs {len(overrides)} override dicts "
+            "(the caller applies the fan rule first)"
+        )
+    cfg_json = cfg.to_json()
+    units = []
+    for i, ((kind, src), ov) in enumerate(zip(sources, overrides)):
+        unit = {
+            "unit_id": f"u{i:05d}",
+            "index": i,
+            "config": cfg_json,
+            "trace_path": src if kind == "trace_path" else None,
+            "synth": src if kind == "synth" else None,
+            "fold": bool(fold),
+            "overrides": dict(ov),
+            "chunk_steps": int(chunk_steps),
+            "max_steps": int(max_steps),
+            "warm_cache": bool(warm_cache),
+        }
+        unit["key"] = unit_key(unit)
+        units.append(unit)
+    return units
+
+
+def fold_unit_records(records: list[dict]):
+    """Fold a replayed pool ledger into restart state:
+    `(units, clean_drain)` where `units` maps unit_id -> {result,
+    result_epoch, kills, max_epoch, poison, resumed_steps}.
+
+    Invariants (tested under duplicates and out-of-order delivery):
+    - first ACK wins: the first `ack` per unit is kept verbatim; every
+      later ack for that unit is a discarded duplicate, whatever its
+      epoch says;
+    - an `ack` is authoritative even when its `lease` record was never
+      seen (out-of-order append across a torn tail);
+    - `expire` records accumulate DISTINCT workers per unit (poison
+      evidence survives coordinator restarts); expires arriving after
+      the ack don't un-finish the unit;
+    - `poison` marks stick unless the unit also has a result (a hedged
+      twin finished before the poison verdict landed — the result wins,
+      the campaign keeps the data)."""
+    units: dict[str, dict] = {}
+    clean_drain = False
+
+    def _u(unit_id: str) -> dict:
+        return units.setdefault(
+            unit_id,
+            {"result": None, "result_epoch": None, "kills": set(),
+             "max_epoch": 0, "poison": False, "resumed_steps": 0,
+             "key": None},
+        )
+
+    for rec in records:
+        t = rec.get("t")
+        if t == "lease":
+            u = _u(str(rec["unit_id"]))
+            u["max_epoch"] = max(u["max_epoch"], int(rec.get("epoch", 0)))
+            u["key"] = u["key"] or rec.get("key")
+            clean_drain = False
+        elif t == "expire":
+            u = _u(str(rec["unit_id"]))
+            u["kills"].add(str(rec.get("worker", "?")))
+            u["max_epoch"] = max(u["max_epoch"], int(rec.get("epoch", 0)))
+            clean_drain = False
+        elif t == "ack":
+            u = _u(str(rec["unit_id"]))
+            if u["result"] is None:  # first ACK wins; duplicates discarded
+                u["result"] = rec.get("result")
+                u["result_epoch"] = int(rec.get("epoch", 0))
+                u["resumed_steps"] = int(rec.get("resumed_steps", 0))
+                u["key"] = rec.get("key") or u["key"]
+            u["max_epoch"] = max(u["max_epoch"], int(rec.get("epoch", 0)))
+            clean_drain = False
+        elif t == "poison":
+            u = _u(str(rec["unit_id"]))
+            if u["result"] is None:
+                u["poison"] = True
+                u["kills"] |= {str(w) for w in rec.get("kills", [])}
+            clean_drain = False
+        elif t == "drain":
+            clean_drain = True
+    return units, clean_drain

@@ -1,0 +1,358 @@
+"""Pool worker — one process, one fleet element at a time (DESIGN.md §17).
+
+A worker is a pull loop against the coordinator socket: lease a unit,
+materialize its workload locally (deterministic, same contract as
+`serve.scheduler.materialize_workload`), simulate it under a
+`RunSupervisor` whose `on_chunk` callback does the two pool duties —
+
+- element-checkpoint the unit to its deterministic path under the pool
+  directory (atomic tmp+rename), so whoever re-leases this unit after we
+  die resumes from the last committed chunk instead of step 0;
+- heartbeat the lease every ttl/3; a `lost` reply means the coordinator
+  expired or superseded us (we were presumed dead, or a hedge twin won)
+  and we abandon the unit without acking.
+
+The worker NEVER trusts its connection: every coordinator call rides a
+decorrelated-jitter reconnect loop (util.backoff), and a heartbeat that
+cannot reach the coordinator is tolerated — we keep simulating, because
+first-ACK-wins means a result computed during a network hole still
+counts when the link returns. Only when the coordinator stays dark past
+`reconnect_timeout_s` does the worker give up (exit 75, EX_TEMPFAIL).
+
+Crash injection for the chaos tests: `crash_after_chunks=N` SIGKILLs
+this process at the Nth committed chunk boundary — a deterministic
+stand-in for the OOM killer. In-process tests use `simulate_crash=True`
+instead, which raises `SimulatedCrash` at the same point (the test then
+plays the role of the dead process by simply not acking).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from ..serve.protocol import request
+from ..util.backoff import DecorrelatedJitter, jittered
+
+EX_TEMPFAIL = 75
+
+
+class LeaseLost(Exception):
+    """Coordinator told us the lease is gone (expired and re-dispatched,
+    or the unit already finished) — abandon the unit, take the next."""
+
+
+class SimulatedCrash(Exception):
+    """In-process stand-in for SIGKILL: the test's worker vanishes
+    mid-unit without acking or cleaning up."""
+
+
+class _Heartbeat:
+    """Background lease keep-alive for one unit. Runs on its own daemon
+    thread so the lease survives phases where the simulation can't reach
+    a chunk boundary — trace materialization and especially the first
+    chunk's JIT compilation, which alone can outlast a short TTL. The
+    thread only SETS flags; the simulating thread raises LeaseLost at
+    the next chunk boundary (a clean commit point)."""
+
+    def __init__(self, worker: "PoolWorker", unit_id: str, epoch: int,
+                 interval_s: float):
+        self.worker = worker
+        self.unit_id = unit_id
+        self.epoch = epoch
+        self.interval_s = interval_s
+        self.lost = False
+        self.steps = 0  # updated by the simulating thread
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> "_Heartbeat":
+        self._t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=2.0)
+
+    def _run(self) -> None:
+        down_since = None
+        while not self._stop.wait(self.interval_s):
+            try:
+                reply = self.worker._call({
+                    "verb": "heartbeat",
+                    "unit_id": self.unit_id,
+                    "epoch": self.epoch,
+                    "steps": int(self.steps),
+                }, patient=False)
+                down_since = None
+            except (ConnectionError, OSError):
+                # keep simulating through a network hole: first-ACK-wins
+                # makes the result still worth computing, unless the
+                # coordinator stays dark past the reconnect window
+                now = time.monotonic()
+                if down_since is None:
+                    down_since = now
+                elif now - down_since >= self.worker.reconnect_timeout_s:
+                    self.lost = True
+                    return
+                continue
+            if reply.get("lost"):
+                self.lost = True
+                return
+
+
+class PoolWorker:
+    def __init__(
+        self,
+        socket_path: str,
+        worker_id: str,
+        warm_cache: bool = False,
+        reconnect_timeout_s: float = 60.0,
+        crash_after_chunks: int | None = None,
+        simulate_crash: bool = False,
+        rng=None,
+    ):
+        self.socket_path = str(socket_path)
+        self.worker_id = str(worker_id)
+        self.warm_cache = bool(warm_cache)
+        self.reconnect_timeout_s = float(reconnect_timeout_s)
+        self.crash_after_chunks = crash_after_chunks
+        self.simulate_crash = bool(simulate_crash)
+        self.rng = rng
+        self.units_done = 0
+        self.units_lost = 0
+        self._chunks_seen = 0
+
+    # ---- coordinator RPC with reconnect ----------------------------------
+
+    def _call(self, req: dict, patient: bool = True) -> dict:
+        """One verb round-trip. With `patient`, connection failures retry
+        under decorrelated jitter until `reconnect_timeout_s` of
+        continuous darkness, then re-raise (the campaign is gone)."""
+        req = {**req, "worker": self.worker_id}
+        jitter = DecorrelatedJitter(base=0.2, cap=5.0, rng=self.rng)
+        deadline = time.monotonic() + self.reconnect_timeout_s
+        while True:
+            try:
+                return request(self.socket_path, req)
+            except (ConnectionError, OSError):
+                if not patient or time.monotonic() >= deadline:
+                    raise
+                time.sleep(jitter.next_delay())
+
+    # ---- the pull loop ---------------------------------------------------
+
+    def run(self) -> int:
+        """Lease/execute until the coordinator says the campaign is done
+        (exit 0) or stays unreachable (exit 75)."""
+        while True:
+            try:
+                reply = self._call({"verb": "lease"})
+            except (ConnectionError, OSError):
+                return EX_TEMPFAIL
+            if not reply.get("ok", False):
+                time.sleep(jittered(1.0, rng=self.rng))
+                continue
+            if reply.get("done"):
+                return 0
+            if reply.get("idle"):
+                time.sleep(
+                    jittered(float(reply.get("retry_after_s", 1.0)),
+                             rng=self.rng)
+                )
+                continue
+            self.run_unit(reply)
+
+    # ---- unit execution --------------------------------------------------
+
+    def run_unit(self, grant: dict) -> None:
+        """Simulate one leased unit and ack its result. Lease loss
+        abandons silently; workload errors ack a quarantined result so
+        the campaign records the casualty and moves on."""
+        unit = grant["unit"]
+        epoch = int(grant["epoch"])
+        try:
+            result, resumed_steps = self._simulate(grant)
+        except LeaseLost:
+            self.units_lost += 1
+            return
+        except SimulatedCrash:
+            raise
+        except Exception as e:  # noqa: BLE001 — a bad unit must not kill us
+            result = _quarantine_result(unit, e)
+            resumed_steps = 0
+        try:
+            self._call({
+                "verb": "ack",
+                "unit_id": unit["unit_id"],
+                "epoch": epoch,
+                "key": unit["key"],
+                "result": result,
+                "resumed_steps": resumed_steps,
+            })
+            self.units_done += 1
+        except (ConnectionError, OSError):
+            # result lost with the coordinator; the unit's checkpoint
+            # survives, so the re-lease (to us or a peer) is cheap
+            self.units_lost += 1
+
+    def _simulate(self, grant: dict) -> tuple[dict, int]:
+        unit = grant["unit"]
+        unit_id = unit["unit_id"]
+        epoch = int(grant["epoch"])
+        ttl = float(grant.get("lease_ttl_s", 10.0))
+        ckpt_path = os.path.join(
+            grant["pool_dir"], "units", f"{unit_id}.npz"
+        )
+        # keep-alive from the moment of the grant: materialization + JIT
+        # compilation happen before the first chunk boundary and must not
+        # look like a death to the coordinator
+        hb = _Heartbeat(self, unit_id, epoch,
+                        interval_s=max(0.1, ttl / 3.0)).start()
+        try:
+            return self._simulate_leased(grant, unit, unit_id, ckpt_path,
+                                         hb)
+        finally:
+            hb.stop()
+
+    def _simulate_leased(self, grant, unit, unit_id, ckpt_path,
+                         hb) -> tuple[dict, int]:
+        from ..config.machine import MachineConfig
+        from ..serve.scheduler import parse_synth_spec
+        from ..sim.checkpoint import load_element_checkpoint
+        from ..sim.fleet import FleetEngine
+        from ..sim.supervisor import RunSupervisor
+        from ..trace.format import Trace, fold_ins
+
+        cfg = MachineConfig.from_json(unit["config"])
+        if unit["synth"] is not None:
+            trace = parse_synth_spec(unit["synth"], cfg.n_cores,
+                                     unit["fold"])
+        else:
+            trace = Trace.load(unit["trace_path"])
+            if unit["fold"]:
+                trace = fold_ins(trace)
+        fleet = FleetEngine(
+            cfg, [trace], [dict(unit["overrides"])],
+            chunk_steps=int(unit["chunk_steps"]),
+        )
+
+        resumed_steps = 0
+        if grant.get("checkpoint"):
+            try:
+                snap = load_element_checkpoint(
+                    ckpt_path, fleet.elem_cfgs[0], trace
+                )
+                fleet.restore_element(0, snap)
+                resumed_steps = int(fleet.steps_run[0])
+            except Exception:  # corrupt/mismatched: fresh start
+                resumed_steps = 0
+        if resumed_steps == 0 and unit.get("warm_cache") and self.warm_cache:
+            resumed_steps = self._warm_fork(fleet, trace)
+
+        def on_chunk(sup):
+            self._chunks_seen += 1
+            # checkpoint BEFORE the crash-injection point: a worker killed
+            # at chunk N leaves chunk N durable, so the re-lease resumes
+            # exactly where the victim died
+            self._checkpoint(ckpt_path, fleet, unit_id)
+            if self.crash_after_chunks is not None \
+                    and self._chunks_seen >= self.crash_after_chunks:
+                if self.simulate_crash:
+                    raise SimulatedCrash(unit_id)
+                os.kill(os.getpid(), signal.SIGKILL)
+            hb.steps = int(fleet.steps_run[0])
+            if hb.lost:
+                # expired-and-superseded, or the coordinator stayed dark
+                # past the reconnect window: abandon at this clean commit
+                # point (the checkpoint above stays for whoever re-leases)
+                raise LeaseLost(unit_id)
+
+        sup = RunSupervisor(fleet, handle_signals=False, on_chunk=on_chunk)
+        t0 = time.perf_counter()
+        sup.run(max_steps=int(unit["max_steps"]))
+        wall = time.perf_counter() - t0
+
+        # the per-element record, byte-for-byte the shape `primetpu
+        # sweep` emits in-process — the chaos CI diff depends on it
+        ec = fleet.element_counters(0)
+        ins = int(ec["instructions"].sum())
+        result = {
+            "metric": "simulated_MIPS",
+            "value": round(ins / max(wall, 1e-9) / 1e6, 3),
+            "unit": "MIPS",
+            "detail": {
+                "engine": "fleet",
+                "fleet_index": unit["index"],
+                "n_cores": cfg.n_cores,
+                "instructions": ins,
+                "max_core_cycles": int(fleet.cycles[0].max()),
+                "overrides": dict(unit["overrides"]),
+                "wall_s": round(wall, 3),
+                "noc_msgs": int(ec["noc_msgs"].sum()),
+            },
+        }
+        return result, resumed_steps
+
+    def _checkpoint(self, path: str, fleet, unit_id: str) -> None:
+        from ..sim.checkpoint import save_element_checkpoint
+
+        save_element_checkpoint(path, fleet, 0, job_id=unit_id)
+
+    def _warm_fork(self, fleet, trace) -> int:
+        """Warm-state cache consult (DESIGN.md §16) for a fresh unit:
+        fork from the deepest proven prefix of this exact workload."""
+        from ..sim.checkpoint import (
+            CheckpointCorrupt,
+            find_warm_states,
+            load_warm_state,
+            trace_fingerprint,
+            warm_cache_root,
+        )
+
+        root = warm_cache_root()
+        ecfg = fleet.elem_cfgs[0]
+        fp = trace_fingerprint(trace)
+        for steps, key in find_warm_states(root, ecfg, fp):
+            try:
+                snap = load_warm_state(root, key, ecfg, fp, steps)
+            except (FileNotFoundError, CheckpointCorrupt, ValueError):
+                continue
+            fleet.fork_element(0, snap, cache_key=key)
+            return steps
+        return 0
+
+
+def _quarantine_result(unit: dict, exc: BaseException) -> dict:
+    from ..serve.protocol import error_obj
+
+    return {
+        "metric": "quarantined",
+        "value": None,
+        "unit": None,
+        "detail": {
+            "engine": "fleet",
+            "fleet_index": unit["index"],
+            "status": "quarantined",
+            "overrides": dict(unit["overrides"]),
+            **error_obj(exc),
+        },
+    }
+
+
+def run_worker(
+    socket_path: str,
+    worker_id: str,
+    warm_cache: bool = False,
+    reconnect_timeout_s: float = 60.0,
+    crash_after_chunks: int | None = None,
+) -> int:
+    return PoolWorker(
+        socket_path,
+        worker_id,
+        warm_cache=warm_cache,
+        reconnect_timeout_s=reconnect_timeout_s,
+        crash_after_chunks=crash_after_chunks,
+    ).run()

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import time
 
+from ..util.backoff import jittered
 from .protocol import request
 
 
@@ -69,7 +70,9 @@ class ServeClient:
                 if e.retry_after_s is None or attempt >= retries:
                     raise
                 attempt += 1
-                time.sleep(float(e.retry_after_s))
+                # jitter the server's hint (util.backoff): N clients told
+                # "retry in 5s" must not resubmit in the same instant
+                time.sleep(jittered(float(e.retry_after_s)))
 
     def status(self, job_id: str | None = None) -> dict | list:
         reply = self._call({"verb": "status", "job_id": job_id})

@@ -164,7 +164,18 @@ def fold_records(records: list[dict]):
     restarts from: `(jobs, clean_drain)` where `jobs` maps job_id ->
     rebuilt Job (terminal jobs carry their journaled result; non-terminal
     ones are back in PENDING, ready to re-enqueue) and `clean_drain` is
-    True when the log ends with a drain marker (graceful shutdown)."""
+    True when the log ends with a drain marker (graceful shutdown).
+
+    The fold is FIRST-TERMINAL-WINS and duplicate-tolerant — the
+    property the pool coordinator's lease-epoch/first-ACK-wins protocol
+    (DESIGN.md §17) leans on when it reuses this journal:
+
+    - a duplicate `accept` for a known job_id is ignored (re-accepting
+      must not resurrect a job that already reached a terminal state);
+    - once a job is terminal, later non-terminal records (a RUNNING
+      record from a hedged or re-leased attempt, delivered out of order)
+      do not demote it, and later terminal records do not overwrite the
+      first result."""
     from .jobs import RUNNING, TERMINAL_STATES, Job
 
     jobs: dict[str, Job] = {}
@@ -173,13 +184,20 @@ def fold_records(records: list[dict]):
         t = rec.get("t")
         if t == "accept":
             job = Job.from_accept_record(rec["job"])
-            jobs[job.job_id] = job
+            if job.job_id not in jobs:  # duplicate accept: first wins
+                jobs[job.job_id] = job
             clean_drain = False
         elif t == "state":
             job = jobs.get(rec["job_id"])
             if job is None:
                 continue  # state for a job we never saw accepted
             state = rec["state"]
+            if job.state in TERMINAL_STATES:
+                # terminal is forever: a late RUNNING (out-of-order
+                # redispatch) or a duplicate terminal (second ACK of a
+                # hedged pair) never rewrites the first outcome
+                clean_drain = False
+                continue
             if state in TERMINAL_STATES:
                 job.state = state
                 job.detail = rec.get("detail") or {}

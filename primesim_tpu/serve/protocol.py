@@ -80,6 +80,42 @@ def read_line(f) -> dict | None:
     return decode(line)
 
 
+def socket_alive(sock_path: str, timeout_s: float = 0.5) -> bool:
+    """True when something ACCEPTS connections on `sock_path`. False for
+    a missing path or a STALE socket file — the inode a SIGKILLed daemon
+    leaves behind, which refuses connections because no process listens.
+    A connect that times out counts as alive (a bound-but-busy peer)."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        s.settimeout(timeout_s)
+        s.connect(sock_path)
+        return True
+    except socket.timeout:
+        return True  # bound and backlogged — definitely not stale
+    except OSError:
+        return False  # ENOENT / ECONNREFUSED: absent or dead
+    finally:
+        s.close()
+
+
+def claim_socket_path(sock_path: str) -> None:
+    """Make `sock_path` bindable: probe an existing socket file and
+    unlink it ONLY when dead (previous owner was SIGKILLed and never got
+    to clean up). A live listener raises — silently stealing a running
+    daemon's socket would orphan it mid-service."""
+    import os
+
+    if not os.path.exists(sock_path):
+        return
+    if socket_alive(sock_path):
+        raise RuntimeError(
+            f"{sock_path}: a live server already accepts connections "
+            "here; refusing to steal its socket (stop it first, or pick "
+            "another --socket path)"
+        )
+    os.unlink(sock_path)  # stale: previous owner died without cleanup
+
+
 def request(sock_path: str, req: dict, timeout_s: float = 30.0) -> dict:
     """One request/reply round trip against the server socket."""
     with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:

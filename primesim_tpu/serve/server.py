@@ -35,7 +35,7 @@ import time
 
 from . import jobs as J
 from .journal import JobJournal, fold_records
-from .protocol import encode, error_obj, read_line
+from .protocol import claim_socket_path, encode, error_obj, read_line
 from .scheduler import DEFAULT_BUCKETS, QueueFull, Scheduler
 
 EX_TEMPFAIL = 75  # drained with work remaining; restart to continue
@@ -313,8 +313,10 @@ class PrimeServer:
             daemon_threads = True
             allow_reuse_address = True
 
-        if os.path.exists(self.socket_path):
-            os.unlink(self.socket_path)
+        # a socket file may be left over from a SIGKILLed predecessor:
+        # probe it and unlink only if dead (claim_socket_path raises on a
+        # LIVE listener instead of stealing its socket)
+        claim_socket_path(self.socket_path)
         return Listener(self.socket_path, Handler)
 
     def _wait_reply(self, req: dict) -> dict:

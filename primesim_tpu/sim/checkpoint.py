@@ -25,6 +25,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import tempfile
 import zlib
 
 import jax.numpy as jnp
@@ -68,7 +69,8 @@ class CheckpointCorrupt(ValueError):
 def atomic_save_npz(path: str, **arrays) -> None:
     """Write an npz atomically with per-array CRC32s.
 
-    The bytes go to `<path>.tmp` first, are flushed and fsynced, and
+    The bytes go to a writer-unique temp file beside `path` first, are
+    flushed and fsynced, and
     only then `os.replace`d over `path` — so `path` always holds either
     the previous complete snapshot or the new complete snapshot, never a
     torn hybrid (the POSIX rename-is-atomic contract). A `crc_json`
@@ -85,9 +87,17 @@ def atomic_save_npz(path: str, **arrays) -> None:
     named[_CRC_KEY] = np.frombuffer(
         json.dumps(crcs, sort_keys=True).encode(), dtype=np.uint8
     )
-    tmp = f"{path}.tmp"
+    # the temp name must be unique PER WRITER, not per destination: a
+    # hedged pool pair checkpoints the same unit path from two processes
+    # concurrently, and a shared `<path>.tmp` lets one writer rename the
+    # other's file away mid-flight (observed as FileNotFoundError on the
+    # loser's os.replace)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+    )
     try:
-        with open(tmp, "wb") as f:
+        with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, **named)
             f.flush()
             os.fsync(f.fileno())

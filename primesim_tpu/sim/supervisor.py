@@ -454,8 +454,13 @@ class RunSupervisor:
         return True
 
     def _advance_with_retry(self, budget_left: int) -> int:
+        from ..util.backoff import DecorrelatedJitter
+
         attempt = 0
-        delay = self.backoff_s
+        # decorrelated jitter (util.backoff): a fault front that knocks
+        # over N supervised workers at once must not produce N
+        # phase-locked retry storms
+        backoff = DecorrelatedJitter(base=self.backoff_s, cap=30.0)
         while True:
             snap = self._host_snapshot()
             try:
@@ -488,6 +493,7 @@ class RunSupervisor:
                         f"(attempt {attempt}/{self.max_retries})",
                     )
                 else:
+                    delay = backoff.next_delay()
                     self._log(
                         "retry",
                         f"transient failure ({e}); backing off "
@@ -495,7 +501,6 @@ class RunSupervisor:
                         f"{self.max_retries})",
                     )
                     time.sleep(delay)
-                    delay = min(delay * 2, 30.0)
 
     # ---- chaos mode -----------------------------------------------------
 

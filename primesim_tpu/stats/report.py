@@ -29,6 +29,7 @@ def render_report(
     resilience: list[str] | None = None,
     service: dict | None = None,
     timeline: dict | None = None,
+    pool: dict | None = None,
 ) -> str:
     """Render the reference-style text report.
 
@@ -44,6 +45,10 @@ def render_report(
     `timeline` (obs.MetricStore.summary(), present only when `--obs` is
     on) appends a TIMELINE section: per-chunk throughput extremes and
     the slowest chunk's index in the run.
+    `pool` (PoolCoordinator.pool_report()) appends a POOL section: unit
+    outcomes and the lease protocol's decisions — redispatches, expired
+    leases, hedges, duplicate acks — for an elastic `sweep --workers`
+    campaign.
     """
     C = cfg.n_cores
     ins = counters["instructions"].astype(np.int64)
@@ -167,6 +172,18 @@ def render_report(
                 add(f"  latency {p}         {lat[p]:>16.3f}s")
         if service.get("uptime_s") is not None:
             add(f"  uptime seconds      {float(service['uptime_s']):>16.1f}")
+    if pool:
+        add("")
+        add("POOL")
+        add(f"  units total         {int(pool.get('units_total', 0)):>16,}")
+        add(f"  units done          {int(pool.get('units_done', 0)):>16,}")
+        add(f"  units poisoned      {int(pool.get('units_poisoned', 0)):>16,}")
+        add(f"  workers seen        {int(pool.get('workers_seen', 0)):>16,}")
+        add(f"  expired leases      {int(pool.get('expired_leases', 0)):>16,}")
+        add(f"  redispatches        {int(pool.get('redispatches', 0)):>16,}")
+        add(f"  hedges              {int(pool.get('hedges', 0)):>16,}")
+        add(f"  duplicate acks      {int(pool.get('duplicate_acks', 0)):>16,}")
+        add(f"  heartbeats          {int(pool.get('heartbeats', 0)):>16,}")
     add("=" * 72)
     return "\n".join(lines) + "\n"
 

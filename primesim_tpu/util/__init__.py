@@ -1,0 +1,5 @@
+"""Small shared host-side utilities (no JAX imports)."""
+
+from .backoff import DecorrelatedJitter, jittered
+
+__all__ = ["DecorrelatedJitter", "jittered"]

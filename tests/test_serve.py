@@ -128,6 +128,65 @@ def test_journal_ack_is_durable(tmp_path):
     assert recs and recs[0]["job"]["job_id"] == "j000007"
 
 
+def test_fold_records_first_terminal_wins_under_duplicates():
+    """The fold invariants the pool coordinator's first-ACK-wins lease
+    protocol leans on: duplicate accepts are ignored, the first terminal
+    state is forever, and late RUNNING records (an out-of-order
+    redispatch) never demote a finished job."""
+    job = _job(3)
+    acc = {"t": "accept", "job": job.accept_record()}
+    run = {"t": "state", "job_id": job.job_id, "state": "RUNNING"}
+    done = {"t": "state", "job_id": job.job_id, "state": "DONE",
+            "result": {"cycles": 1}}
+    late = {"t": "state", "job_id": job.job_id, "state": "DONE",
+            "result": {"cycles": 999}}
+
+    jobs, clean = fold_records([acc, run, done, acc, run, late])
+    assert jobs[job.job_id].state == "DONE"
+    assert jobs[job.job_id].result == {"cycles": 1}  # first terminal wins
+    assert not clean
+
+    # RUNNING at crash (no terminal record) folds back to PENDING
+    jobs2, _ = fold_records([acc, run])
+    assert jobs2[job.job_id].state == "PENDING"
+
+    # a state record for a never-accepted job is skipped, and a drain
+    # marker only counts when it is the LAST thing in the log
+    jobs3, clean3 = fold_records([run, acc, {"t": "drain"}])
+    assert jobs3[job.job_id].state == "PENDING"
+    assert clean3
+    assert not fold_records([{"t": "drain"}, acc])[1]
+
+
+def test_claim_socket_path_unlinks_stale_refuses_live(tmp_path):
+    """The stale-socket regression: a SIGKILLed daemon leaves its socket
+    inode behind; the next bind must reclaim it — but never steal a
+    LIVE listener's path."""
+    import socket as socketmod
+
+    from primesim_tpu.serve.protocol import claim_socket_path, socket_alive
+
+    path = str(tmp_path / "srv.sock")
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.bind(path)
+    s.close()  # bound then dead: the corpse a kill -9 leaves
+    assert os.path.exists(path) and not socket_alive(path)
+    claim_socket_path(path)
+    assert not os.path.exists(path)
+    claim_socket_path(path)  # absent path is a no-op
+
+    srv = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    try:
+        srv.bind(path)
+        srv.listen(1)
+        assert socket_alive(path)
+        with pytest.raises(RuntimeError, match="live server"):
+            claim_socket_path(path)
+        assert os.path.exists(path)  # the running daemon keeps its door
+    finally:
+        srv.close()
+
+
 # ---- job state machine ---------------------------------------------------
 
 
