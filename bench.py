@@ -20,6 +20,9 @@ continuous-batching scheduler at sustained 8-slot occupancy vs the
 static batch-8 sweep). `PRIMETPU_BENCH_FORK=0` skips the
 sweep_fork_speedup measurement (a 16-seed chaos campaign with the
 shared prefix forked once vs simulated 16 times, DESIGN.md §16).
+`PRIMETPU_BENCH_UNIFIED=0` skips the unified_serve_speedup measurement
+(the same job batch through the TCP front-end dispatching to 3 vs 1
+real pool workers, DESIGN.md §18).
 
 Rung-3 knobs: `PRIMETPU_BENCH_RUNG3=0` skips the rung-3 measurement;
 `PRIMETPU_BENCH_RUNG3_FLOOR=<mips>` makes the regression gate HARD
@@ -445,6 +448,92 @@ def main() -> None:
             "passed": bool(pool_speedup >= 1.5),
         }
 
+    # unified elastic serving economics (DESIGN.md §18): the same job
+    # batch submitted to the TCP front-end, dispatched to an autoscaled
+    # fleet of 3 vs 1 real pool-worker processes — front-end, coordinator
+    # and workers all real processes, so the measurement prices the whole
+    # unified stack (admission journal fsyncs, enqueue/collect RPCs,
+    # lease protocol, per-worker JIT compile) against the parallelism.
+    # Advisory at 2.0x (never hard: collapses on starved CI runners).
+    # PRIMETPU_BENCH_UNIFIED=0 skips (metric reports null).
+    unified_detail = None
+    unified_gate = None
+    if os.environ.get("PRIMETPU_BENCH_UNIFIED", "1") != "0":
+        import re as _re
+        import subprocess
+        import tempfile
+
+        from primesim_tpu.config.machine import small_test_config
+        from primesim_tpu.serve.client import ServeClient
+
+        uni_tmp = tempfile.mkdtemp(prefix="primetpu-bench-unified-")
+        uni_cfg_path = os.path.join(uni_tmp, "cfg.json")
+        with open(uni_cfg_path, "w") as f:
+            f.write(small_test_config(4).to_json())
+        UNI_JOBS = 12
+
+        def _unified_campaign(workers: int) -> float:
+            sdir = os.path.join(uni_tmp, f"w{workers}")
+            os.makedirs(sdir, exist_ok=True)
+            err_path = os.path.join(sdir, "serve.log")
+            srv = subprocess.Popen(
+                [sys.executable, "-m", "primesim_tpu.cli", "serve",
+                 uni_cfg_path,
+                 "--state-dir", os.path.join(sdir, "state"),
+                 "--tcp", "127.0.0.1:0",
+                 "--pool-dir", os.path.join(sdir, "pool"),
+                 "--workers", str(workers), "--chunk-steps", "64"],
+                stdout=subprocess.DEVNULL, stderr=open(err_path, "w"),
+            )
+            try:
+                target = None
+                for _ in range(1800):
+                    m = _re.search(r"serve: listening on (\S+)",
+                                   open(err_path).read())
+                    if m:
+                        target = m.group(1)
+                        break
+                    if srv.poll() is not None:
+                        raise RuntimeError(
+                            "front-end died: "
+                            + open(err_path).read()[-500:]
+                        )
+                    time.sleep(0.1)
+                cli = ServeClient(target, timeout_s=60.0)
+                t0 = time.perf_counter()
+                ids = [
+                    cli.submit(
+                        synth=f"stream:n_mem_ops=400,seed={i}",
+                        client=f"bench{i % 2}",
+                    )["job_id"]
+                    for i in range(UNI_JOBS)
+                ]
+                for jid in ids:
+                    job = cli.wait(jid, timeout_s=900.0)
+                    assert job["state"] == "DONE", job
+                wall = time.perf_counter() - t0
+                cli.drain()
+                srv.wait(timeout=120)
+                return wall
+            finally:
+                if srv.poll() is None:
+                    srv.kill()
+
+        uni_wall_1 = _unified_campaign(1)
+        uni_wall_3 = _unified_campaign(3)
+        uni_speedup = uni_wall_1 / uni_wall_3
+        unified_detail = {
+            "jobs": UNI_JOBS,
+            "wall_s_workers1": round(uni_wall_1, 3),
+            "wall_s_workers3": round(uni_wall_3, 3),
+            "speedup_x": round(uni_speedup, 3),
+        }
+        unified_gate = {
+            "floor_x": 2.0,
+            "hard": False,
+            "passed": bool(uni_speedup >= 2.0),
+        }
+
     # the headline machine: cumulative ms/step at each phase marker, so
     # every bench artifact carries the serial-chain decomposition next to
     # the static r5 record. PRIMETPU_BENCH_PHASE_CUTS=0 skips (each cut
@@ -502,6 +591,13 @@ def main() -> None:
                     "pool_sweep_speedup": (
                         pool_detail["speedup_x"] if pool_detail else None
                     ),
+                    # the same job batch through the unified TCP
+                    # front-end at 3 vs 1 pool workers (null when
+                    # PRIMETPU_BENCH_UNIFIED=0; advisory gate >= 2.0x)
+                    "unified_serve_speedup": (
+                        unified_detail["speedup_x"]
+                        if unified_detail else None
+                    ),
                 },
                 "detail": {
                     "n_cores": C,
@@ -546,6 +642,11 @@ def main() -> None:
                     # when PRIMETPU_BENCH_POOL=0)
                     "pool_sweep": pool_detail,
                     "pool_sweep_gate": pool_gate,
+                    # unified elastic serving (DESIGN.md §18): the same
+                    # job batch through the TCP front-end at 3 vs 1
+                    # workers (null when PRIMETPU_BENCH_UNIFIED=0)
+                    "unified_serve": unified_detail,
+                    "unified_serve_gate": unified_gate,
                     # STATIC RECORD: round-5 restructure evidence measured
                     # on TPU 2026-07-30 (scripts/prof/prof_phase.py
                     # cumulative cuts / prof_bisect.py ablations,

@@ -902,7 +902,89 @@ def cmd_worker(ns) -> int:
         warm_cache=ns.warm_cache == "on",
         reconnect_timeout_s=ns.reconnect_timeout,
         crash_after_chunks=ns.crash_after_chunks,
+        idle_exit_s=ns.idle_exit,
     )
+
+
+def cmd_coordinator(ns) -> int:
+    """Standalone dynamic-mode pool coordinator (DESIGN.md §18): the
+    lease/heartbeat/ack bookkeeper for an elastic serving fleet.
+    Normally spawned by `primetpu serve --pool-dir`; run by hand for a
+    shared pool several front-ends dispatch into. SIGTERM/SIGINT close
+    the socket and flush the unit ledger; kill -9 at any instant is
+    recoverable — restarting over the same --pool-dir replays every
+    enqueued unit, adopts acked results, and re-adopts live worker
+    leases by heartbeat epoch."""
+    import os
+    import signal as _signal
+
+    from ..pool.coordinator import PoolCoordinator
+    from ..serve.protocol import socket_alive
+
+    sock = ns.socket or os.path.join(ns.pool_dir, "pool.sock")
+    if socket_alive(sock):
+        # Probe BEFORE constructing: __init__ replays the shared ledger
+        # and journals a recovery note, which a losing standby must not
+        # spam into the live coordinator's journal.
+        print(
+            f"coordinator: a live coordinator already owns {sock}",
+            file=sys.stderr,
+        )
+        return 1
+
+    rec = _build_recorder(ns)
+    coord = PoolCoordinator(
+        [],
+        pool_dir=ns.pool_dir,
+        socket_path=ns.socket,
+        lease_ttl_s=ns.lease_ttl,
+        poison_threshold=ns.poison_threshold,
+        hedge=ns.hedge == "on",
+        obs=rec,
+        dynamic=True,
+    )
+    try:
+        coord.start()
+    except RuntimeError as e:  # lost the bind race to another standby
+        print(f"coordinator: {e}", file=sys.stderr)
+        return 1
+    pid_path = os.path.join(ns.pool_dir, "coordinator.pid")
+    with open(pid_path, "w") as f:
+        f.write(str(os.getpid()))
+    stop = {"flag": False}
+
+    def _term(signum, frame):
+        stop["flag"] = True
+
+    try:
+        _signal.signal(_signal.SIGTERM, _term)
+        _signal.signal(_signal.SIGINT, _term)
+    except ValueError:
+        pass
+    r = coord.recovered
+    print(
+        f"coordinator: listening on {coord.socket_path} "
+        f"(recovered units={r.get('units_respawned', 0)} "
+        f"results={r.get('results_adopted', 0)} "
+        f"leases={r.get('leases_readopted', 0)})",
+        file=sys.stderr,
+    )
+    try:
+        while not stop["flag"]:
+            coord.tick()
+            time.sleep(0.2)
+    finally:
+        coord.close()
+        try:
+            os.unlink(pid_path)
+        except OSError:
+            pass
+        _finalize_obs(rec)
+        print(
+            f"coordinator: closed ({json.dumps(coord.pool_report())})",
+            file=sys.stderr,
+        )
+    return 0
 
 
 def cmd_synth(ns) -> int:
@@ -943,13 +1025,16 @@ def cmd_serve(ns) -> int:
     SIGTERM drains (checkpoint + exit 75 when work remains); SIGHUP
     reloads --config's fault schedule (same geometry only)."""
     cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
+    from ..serve.quota import TenantQuota
     from ..serve.server import PrimeServer
 
     rec = _build_recorder(ns)
+    if ns.tcp and ns.socket:
+        raise SystemExit("--tcp and --socket are mutually exclusive")
     server = PrimeServer(
         cfg,
         state_dir=ns.state_dir,
-        socket_path=ns.socket,
+        socket_path=ns.tcp or ns.socket,
         buckets=_parse_buckets(ns.buckets),
         chunk_steps=ns.chunk_steps,
         max_queue=ns.max_queue,
@@ -958,10 +1043,17 @@ def cmd_serve(ns) -> int:
         idle_exit_s=ns.idle_exit,
         obs=rec,
         warm_cache=ns.warm_cache == "on",
+        pool_dir=ns.pool_dir,
+        max_workers=ns.workers,
+        lease_ttl_s=ns.lease_ttl,
+        quota=TenantQuota.parse(ns.quota) if ns.quota else None,
     )
+    # bind before the readiness line so `--tcp HOST:0` prints the real
+    # kernel-assigned port (tests and scripts scrape this line)
+    target = server.bind()
+    mode = f"dispatch->{ns.pool_dir}" if ns.pool_dir else "local"
     print(
-        f"serve: listening on {server.socket_path} "
-        f"(slots={server.sched.total_slots}, "
+        f"serve: listening on {target} ({mode}, "
         f"recovered={server.recovered['jobs_requeued']} job(s))",
         file=sys.stderr,
     )
@@ -1072,7 +1164,17 @@ def cmd_serve_status(ns) -> int:
         elif ns.watch:
             n = 0
             while True:
-                print(_watch_line(cli.health()), flush=True)
+                # the client already retried once on connect failure;
+                # a still-dead target prints DOWN and keeps watching
+                # (the daemon may be mid-restart or failing over)
+                try:
+                    line = _watch_line(cli.health())
+                except (ServeError, OSError) as e:
+                    line = (
+                        f"{time.strftime('%H:%M:%S')}  "
+                        f"DOWN {ns.socket} ({type(e).__name__})"
+                    )
+                print(line, flush=True)
                 n += 1
                 if ns.count and n >= ns.count:
                     break
@@ -1386,7 +1488,43 @@ def build_parser() -> argparse.ArgumentParser:
         "--crash-after-chunks", type=int, default=None,
         help=argparse.SUPPRESS,  # chaos-test hook: SIGKILL self at chunk N
     )
+    k.add_argument(
+        "--idle-exit", type=float, default=None, metavar="SEC",
+        help="exit 0 after SEC seconds of continuous idle (no leases "
+             "granted) — the elastic fleet's scale-down path",
+    )
     k.set_defaults(fn=cmd_worker)
+
+    co = sub.add_parser(
+        "coordinator",
+        help="standalone dynamic-mode pool coordinator for an elastic "
+             "serving fleet (normally spawned by `serve --pool-dir`; "
+             "run by hand to share one pool across front-ends)",
+    )
+    co.add_argument(
+        "--pool-dir", required=True, metavar="DIR",
+        help="unit ledger + checkpoints + default socket live here; "
+             "restarting with the same DIR replays every enqueued unit",
+    )
+    co.add_argument(
+        "--socket", default=None, metavar="PATH|HOST:PORT",
+        help="listen target (default: POOL_DIR/pool.sock; host:port "
+             "listens on TCP, port 0 = kernel-assigned)",
+    )
+    co.add_argument(
+        "--lease-ttl", type=float, default=10.0, metavar="SEC",
+        help="re-dispatch a unit after SEC without a heartbeat",
+    )
+    co.add_argument(
+        "--poison-threshold", type=int, default=3, metavar="N",
+        help="quarantine a unit after it kills N workers",
+    )
+    co.add_argument(
+        "--hedge", choices=("on", "off"), default="on",
+        help="duplicate the straggler unit on idle workers (default on)",
+    )
+    _add_obs_flags(co)
+    co.set_defaults(fn=cmd_coordinator)
 
     c = sub.add_parser(
         "capture",
@@ -1433,6 +1571,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="unix socket path (default: STATE_DIR/serve.sock)",
     )
     v.add_argument(
+        "--tcp", default=None, metavar="HOST:PORT",
+        help="listen on TCP instead of the unix socket (port 0 = "
+             "kernel-assigned; the readiness line prints the real one)",
+    )
+    v.add_argument(
+        "--pool-dir", default=None, metavar="DIR",
+        help="dispatch mode: run jobs on an autoscaling pool-worker "
+             "fleet over this pool directory (spawns a coordinator, or "
+             "adopts one already listening — the standby-takeover path)",
+    )
+    v.add_argument(
+        "--workers", type=int, default=2, metavar="N",
+        help="dispatch mode: autoscale up to N worker processes "
+             "(default 2)",
+    )
+    v.add_argument(
+        "--lease-ttl", type=float, default=10.0, metavar="SEC",
+        help="dispatch mode: pool lease TTL (default 10)",
+    )
+    v.add_argument(
+        "--quota", default=None, metavar="RATE[:BURST]",
+        help="per-tenant admission quota: token bucket of RATE "
+             "submits/sec (burst default max(1,RATE)) per client id; "
+             "rejected submits get retry_after_s backpressure",
+    )
+    v.add_argument(
         "--buckets", default="6x1,2x8", metavar="SxP[,SxP...]",
         help="capacity ladder: SLOTSxPAGES per bucket, one compiled fleet "
              "each, page = 64 event slots/core (default 6x1,2x8)",
@@ -1473,7 +1637,8 @@ def build_parser() -> argparse.ArgumentParser:
         "submit",
         help="submit one job to a running `primetpu serve` daemon",
     )
-    b.add_argument("--socket", required=True, metavar="PATH")
+    b.add_argument("--socket", required=True, metavar="PATH|HOST:PORT",
+                   help="daemon target: unix socket path or TCP host:port")
     b.add_argument("--trace", help="PTPU trace file (server-side path)")
     b.add_argument("--synth", help="synthetic workload spec name[:k=v,...]")
     b.add_argument(
@@ -1504,7 +1669,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="healthz for a running daemon (queue depth, occupancy, "
              "aggregate MIPS, latency percentiles)",
     )
-    t.add_argument("--socket", required=True, metavar="PATH")
+    t.add_argument("--socket", required=True, metavar="PATH|HOST:PORT",
+                   help="daemon target: unix socket path or TCP host:port")
     t.add_argument(
         "--jobs", action="store_true", help="list every known job instead"
     )

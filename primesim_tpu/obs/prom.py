@@ -55,7 +55,7 @@ class _Doc:
 
 
 def render_prometheus(sched, journal=None, draining=False,
-                      recovered=None) -> str:
+                      recovered=None, quota=None) -> str:
     """Render the daemon's scrape payload from a live Scheduler (and
     optionally its JobJournal + the server's recovery/drain state)."""
     s = sched.stats()
@@ -95,6 +95,28 @@ def render_prometheus(sched, journal=None, draining=False,
     d.metric("primetpu_draining", "gauge",
              "1 while the daemon is draining for shutdown.",
              [(None, 1 if draining else 0)])
+    d.metric("primetpu_promotions_total", "counter",
+             "Windowed jobs migrated UP to a larger capacity bucket "
+             "before reaching the window edge (v2 paged allocator).",
+             [(None, getattr(sched, "promotions", 0))])
+    d.metric("primetpu_demotions_total", "counter",
+             "Jobs migrated DOWN to a smaller bucket to unblock a "
+             "queued job that only fits the larger one.",
+             [(None, getattr(sched, "demotions", 0))])
+    d.metric("primetpu_quota_rejections_total", "counter",
+             "Submits rejected by per-tenant admission quotas.",
+             [(None, quota.rejections if quota is not None else 0)])
+    workers = (s.get("workers") or {})
+    if workers:
+        d.metric("primetpu_dispatch_workers", "gauge",
+                 "Live pool-worker processes owned by this front-end "
+                 "(dispatch mode).",
+                 [({"state": "live"}, workers.get("live", 0)),
+                  ({"state": "max"}, workers.get("max", 0))])
+        d.metric("primetpu_dispatch_coordinator_adopted", "gauge",
+                 "1 when this front-end ADOPTED a live coordinator "
+                 "instead of spawning one (standby takeover).",
+                 [(None, 1 if workers.get("coordinator_adopted") else 0)])
 
     last_t = getattr(sched, "last_dispatch_t", None)
     age = (time.time() - last_t) if last_t else float("nan")
@@ -167,6 +189,21 @@ def render_pool_prometheus(coord) -> str:
              [(None, c["poisoned"])])
     d.metric("primetpu_pool_heartbeats_total", "counter",
              "Heartbeats received.", [(None, c["heartbeats"])])
+    d.metric("primetpu_pool_readoptions_total", "counter",
+             "Live worker leases re-adopted by heartbeat epoch after a "
+             "coordinator restart (failover without re-simulation).",
+             [(None, c.get("readoptions", 0))])
+    d.metric("primetpu_pool_enqueued_total", "counter",
+             "Work units accepted via the dynamic enqueue verb.",
+             [(None, c.get("enqueued", 0))])
+    rec = s.get("recovered") or {}
+    if rec:
+        d.metric("primetpu_pool_recovered", "gauge",
+                 "Ledger replay results from the last coordinator start.",
+                 [({"kind": "units_respawned"},
+                   rec.get("units_respawned", 0)),
+                  ({"kind": "results_adopted"},
+                   rec.get("results_adopted", 0))])
     d.metric("primetpu_pool_done", "gauge",
              "1 when every unit is DONE or POISON.",
              [(None, 1 if s["done"] else 0)])

@@ -43,7 +43,13 @@ import threading
 import time
 
 from ..serve.journal import JobJournal
-from ..serve.protocol import claim_socket_path, encode, error_obj, read_line
+from ..serve.protocol import (
+    encode,
+    error_obj,
+    make_listener,
+    parse_target,
+    read_line,
+)
 from . import units as U
 
 
@@ -58,6 +64,7 @@ class PoolCoordinator:
         hedge: bool = True,
         obs=None,
         clock=time.monotonic,
+        dynamic: bool = False,
     ):
         self.pool_dir = str(pool_dir)
         os.makedirs(os.path.join(self.pool_dir, "units"), exist_ok=True)
@@ -67,32 +74,48 @@ class PoolCoordinator:
         self.lease_ttl_s = float(lease_ttl_s)
         self.poison_threshold = int(poison_threshold)
         self.hedge_enabled = bool(hedge)
+        # dynamic mode (the elastic front-end, DESIGN.md §18): units
+        # arrive via the `enqueue` verb instead of a fixed campaign, the
+        # ledger stores their specs (`unit` records), and `done` never
+        # trips — idle workers wait (or --idle-exit) instead of exiting
+        self.dynamic = bool(dynamic)
         self.obs = obs
         self.clock = clock
-        self.journal = JobJournal(self.pool_dir)
+        # segmentation + compaction keep the pool ledger bounded across
+        # long services; pool_compactor preserves fold_unit_records
+        self.journal = JobJournal(self.pool_dir,
+                                  compactor=U.pool_compactor)
         self.journal.obs = obs
 
         self._lock = threading.Lock()
         # unit_id -> mutable coordinator state wrapped around the spec
         self.units: dict[str, dict] = {}
         for spec in units:
-            self.units[spec["unit_id"]] = {
-                "spec": spec,
-                "state": U.PENDING,
-                "epoch": 0,
-                # worker -> {epoch, deadline, granted, steps, hedge}
-                "leases": {},
-                "kills": set(),
-                "result": None,
-                "resumed_steps": 0,
-            }
+            self.units[spec["unit_id"]] = self._entry(spec)
         self.workers_seen: set[str] = set()
         self.counters = {
             "leases": 0, "expired": 0, "redispatches": 0, "hedges": 0,
             "acks": 0, "duplicates": 0, "poisoned": 0, "heartbeats": 0,
+            "readoptions": 0, "enqueued": 0,
         }
+        # per-client round-robin bookkeeping for the QoS lease pick
+        self._last_pick: dict[str, int] = {}
+        self._pick_n = 0
         self.recovered = self._recover()
         self._srv = None
+
+    @staticmethod
+    def _entry(spec: dict) -> dict:
+        return {
+            "spec": spec,
+            "state": U.PENDING,
+            "epoch": 0,
+            # worker -> {epoch, deadline, granted, steps, hedge}
+            "leases": {},
+            "kills": set(),
+            "result": None,
+            "resumed_steps": 0,
+        }
 
     # ---- restart recovery ------------------------------------------------
 
@@ -103,6 +126,18 @@ class PoolCoordinator:
         back to PENDING; their in-flight workers re-adopt their leases on
         the next heartbeat (see `_h_heartbeat`)."""
         records, dropped = self.journal.replay()
+        # first pass: re-create dynamically enqueued units from their
+        # journaled specs (a kill -9'd coordinator has no campaign list
+        # to hand back in — the ledger IS the unit table)
+        respawned = 0
+        for rec in records:
+            if rec.get("t") != "unit":
+                continue
+            spec = rec.get("unit") or {}
+            uid = str(spec.get("unit_id", ""))
+            if uid and uid not in self.units:
+                self.units[uid] = self._entry(spec)
+                respawned += 1
         folded, clean = U.fold_unit_records(records)
         adopted = stale = 0
         for unit_id, f in folded.items():
@@ -127,6 +162,7 @@ class PoolCoordinator:
             "torn_tail_dropped": dropped,
             "results_adopted": adopted,
             "stale_entries": stale,
+            "units_respawned": respawned,
             "clean_drain": clean,
         }
         if records:
@@ -243,6 +279,10 @@ class PoolCoordinator:
                     return self._h_heartbeat(req)
                 if verb == "ack":
                     return self._h_ack(req)
+                if verb == "enqueue":
+                    return self._h_enqueue(req)
+                if verb == "collect":
+                    return self._h_collect(req)
                 if verb == "status":
                     return {"ok": True, **self._stats()}
                 raise ValueError(f"unknown verb {verb!r}")
@@ -255,7 +295,11 @@ class PoolCoordinator:
         self._expire_stale()
         pending = [u for u in self.units.values() if u["state"] == U.PENDING]
         if pending:
-            u = min(pending, key=lambda u: u["spec"]["index"])
+            u = min(pending, key=self._pick_key)
+            self._pick_n += 1
+            self._last_pick[
+                str(u["spec"].get("client", "anon"))
+            ] = self._pick_n
             return self._grant(u, worker, hedge=False)
         if self.done:
             return {"ok": True, "done": True}
@@ -265,6 +309,18 @@ class PoolCoordinator:
                 return self._grant(u, worker, hedge=True)
         return {"ok": True, "idle": True,
                 "retry_after_s": max(0.2, self.lease_ttl_s / 5.0)}
+
+    def _pick_key(self, u: dict):
+        """Lease pick order = the serve scheduler's QoS tiers carried
+        through dispatch: priority first, then least-recently-served
+        client (fairness under one chatty tenant), then campaign index
+        (classic sweeps have neither and keep their index order)."""
+        spec = u["spec"]
+        return (
+            -int(spec.get("priority", 0)),
+            self._last_pick.get(str(spec.get("client", "anon")), 0),
+            int(spec.get("index", 0)),
+        )
 
     def _h_heartbeat(self, req: dict) -> dict:
         worker = str(req.get("worker", "anon"))
@@ -285,6 +341,7 @@ class PoolCoordinator:
                 "deadline": 0.0, "steps": 0, "hedge": False,
             }
             self.workers_seen.add(worker)
+            self.counters["readoptions"] += 1
             self._pool_event("readopt", unit=unit_id, worker=worker,
                              epoch=epoch)
         if lease is None or lease["epoch"] != epoch:
@@ -340,10 +397,69 @@ class PoolCoordinator:
                 pass
         return {"ok": True, "accepted": True}
 
+    def _h_enqueue(self, req: dict) -> dict:
+        """Dynamic-mode admission (the elastic front-end's dispatch
+        path). Idempotent by (unit_id, key): re-enqueueing after a
+        front-end restart replies the unit's CURRENT state — including
+        its result when a worker finished it while the front-end was
+        down — instead of double-scheduling the work."""
+        spec = dict(req.get("unit") or {})
+        unit_id = str(spec.get("unit_id", ""))
+        if not unit_id:
+            raise ValueError("enqueue: unit spec has no unit_id")
+        if spec.get("synth") is None and spec.get("trace_path") is None:
+            raise ValueError(f"enqueue {unit_id}: no synth or trace_path")
+        if not spec.get("config"):
+            raise ValueError(f"enqueue {unit_id}: no config")
+        spec.setdefault("key", U.unit_key(spec))
+        u = self.units.get(unit_id)
+        if u is not None:
+            if u["spec"]["key"] != spec["key"]:
+                raise ValueError(
+                    f"enqueue {unit_id}: key mismatch with the already-"
+                    "enqueued spec (same id, different workload)"
+                )
+            return {"ok": True, "unit_id": unit_id, "state": u["state"],
+                    "result": u["result"],
+                    "resumed_steps": u["resumed_steps"],
+                    "duplicate": True}
+        self.journal.append({"t": "unit", "unit": spec})
+        self.units[unit_id] = self._entry(spec)
+        self.counters["enqueued"] += 1
+        self._pool_event("enqueue", unit=unit_id,
+                         client=spec.get("client", "anon"))
+        return {"ok": True, "unit_id": unit_id, "state": U.PENDING,
+                "result": None, "resumed_steps": 0, "duplicate": False}
+
+    def _h_collect(self, req: dict) -> dict:
+        """Outcomes for the requested unit ids (the front-end polls this
+        to map worker results back onto serve jobs): terminal units in
+        `finished`, currently-leased ids in `leased` (the front-end's
+        PENDING -> RUNNING signal)."""
+        want = req.get("unit_ids")
+        finished, leased = [], []
+        for unit_id in (want if want is not None else self.units):
+            u = self.units.get(str(unit_id))
+            if u is None:
+                continue
+            if u["state"] == U.LEASED:
+                leased.append(u["spec"]["unit_id"])
+            elif u["state"] in (U.DONE, U.POISON):
+                finished.append({
+                    "unit_id": u["spec"]["unit_id"],
+                    "state": u["state"],
+                    "result": u["result"],
+                    "resumed_steps": u["resumed_steps"],
+                    "kills": sorted(u["kills"]),
+                })
+        return {"ok": True, "finished": finished, "leased": leased}
+
     # ---- campaign state --------------------------------------------------
 
     @property
     def done(self) -> bool:
+        if self.dynamic:
+            return False  # a service is never "done"; workers idle-wait
         return all(
             u["state"] in (U.DONE, U.POISON) for u in self.units.values()
         )
@@ -427,13 +543,12 @@ class PoolCoordinator:
                     except (BrokenPipeError, ValueError):
                         return
 
-        class Listener(socketserver.ThreadingMixIn,
-                       socketserver.UnixStreamServer):
-            daemon_threads = True
-            allow_reuse_address = True
-
-        claim_socket_path(self.socket_path)
-        self._srv = Listener(self.socket_path, Handler)
+        self._srv, fam = make_listener(self.socket_path, Handler)
+        if fam == "tcp" and parse_target(self.socket_path)[1][1] == 0:
+            # port 0 = kernel-assigned: rewrite the target so status
+            # lines and spawned workers see the real port
+            host, port = self._srv.server_address[:2]
+            self.socket_path = f"{host}:{port}"
         t = threading.Thread(target=self._srv.serve_forever, daemon=True)
         t.start()
         return self._srv
@@ -449,10 +564,11 @@ class PoolCoordinator:
             self._srv.shutdown()
             self._srv.server_close()
             self._srv = None
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
+        if parse_target(self.socket_path)[0] == "unix":
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
         if drained:
             self.journal.drain()
         self.journal.close()

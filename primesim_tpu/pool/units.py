@@ -126,7 +126,12 @@ def fold_unit_records(records: list[dict]):
 
     for rec in records:
         t = rec.get("t")
-        if t == "lease":
+        if t == "unit":
+            # dynamic-mode spec record (coordinator enqueue); the spec
+            # itself is consumed by the coordinator's recovery pass —
+            # here it only breaks a trailing drain
+            clean_drain = False
+        elif t == "lease":
             u = _u(str(rec["unit_id"]))
             u["max_epoch"] = max(u["max_epoch"], int(rec.get("epoch", 0)))
             u["key"] = u["key"] or rec.get("key")
@@ -154,3 +159,58 @@ def fold_unit_records(records: list[dict]):
         elif t == "drain":
             clean_drain = True
     return units, clean_drain
+
+
+def pool_compactor(records: list[dict]) -> list[dict]:
+    """Compaction fold for the POOL ledger (`JobJournal(compactor=...)`):
+    re-emit the minimal record list whose `fold_unit_records` equals the
+    original history's. Per unit, in first-seen order:
+
+    - the first `unit` spec record (dynamic-mode enqueues — the
+      coordinator's recovery pass rebuilds specs from these);
+    - one synthetic `lease` carrying the fold's `max_epoch` and `key`
+      (worker "compact" — the fold only reads epoch/key from leases);
+    - one `expire` per distinct killer (poison evidence must survive);
+    - the authoritative `ack` (result, result_epoch, resumed_steps) or
+      the `poison` verdict, whichever the fold kept;
+    - the trailing `drain` when the history ended clean.
+
+    `max_epoch >= result_epoch` always holds in a real fold (the ack
+    itself raises max_epoch), so re-folding the compacted list restores
+    both epochs exactly."""
+    specs: dict[str, dict] = {}
+    for rec in records:
+        if rec.get("t") == "unit":
+            spec = rec.get("unit") or {}
+            uid = str(spec.get("unit_id", ""))
+            if uid and uid not in specs:
+                specs[uid] = rec
+    units, clean = fold_unit_records(records)
+    out: list[dict] = []
+    for unit_id, u in units.items():
+        if unit_id in specs:
+            out.append(specs[unit_id])
+        if u["max_epoch"] or u["key"]:
+            out.append({"t": "lease", "unit_id": unit_id,
+                        "worker": "compact", "epoch": u["max_epoch"],
+                        "key": u["key"]})
+        for worker in sorted(u["kills"]):
+            out.append({"t": "expire", "unit_id": unit_id,
+                        "worker": worker, "epoch": 0})
+        if u["result"] is not None:
+            out.append({"t": "ack", "unit_id": unit_id,
+                        "worker": "compact",
+                        "epoch": u["result_epoch"], "key": u["key"],
+                        "result": u["result"],
+                        "resumed_steps": u["resumed_steps"]})
+        elif u["poison"]:
+            out.append({"t": "poison", "unit_id": unit_id,
+                        "key": u["key"], "kills": sorted(u["kills"])})
+    # spec records for units never leased/acked yet (queued work must
+    # survive compaction too)
+    for uid, rec in specs.items():
+        if uid not in units:
+            out.append(rec)
+    if clean:
+        out.append({"t": "drain"})
+    return out

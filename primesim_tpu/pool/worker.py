@@ -78,7 +78,19 @@ class _Heartbeat:
 
     def _run(self) -> None:
         down_since = None
-        while not self._stop.wait(self.interval_s):
+        # any failure — refused connect, reset mid-reply, protocol
+        # garbage from a half-restarted coordinator — must leave this
+        # thread ALIVE and retrying under decorrelated jitter: a dead
+        # keep-alive thread under a healthy simulation looks exactly
+        # like a worker death and gets the lease expired out from under
+        # a run that is still making progress
+        jitter = DecorrelatedJitter(
+            base=min(0.2, self.interval_s),
+            cap=max(self.interval_s, 0.2),
+            rng=self.worker.rng,
+        )
+        wait_s = self.interval_s
+        while not self._stop.wait(wait_s):
             try:
                 reply = self.worker._call({
                     "verb": "heartbeat",
@@ -86,10 +98,9 @@ class _Heartbeat:
                     "epoch": self.epoch,
                     "steps": int(self.steps),
                 }, patient=False)
-                down_since = None
-            except (ConnectionError, OSError):
-                # keep simulating through a network hole: first-ACK-wins
-                # makes the result still worth computing, unless the
+            except Exception:  # noqa: BLE001 — reconnect, never die
+                # keep simulating through the hole: first-ACK-wins makes
+                # the result still worth computing, unless the
                 # coordinator stays dark past the reconnect window
                 now = time.monotonic()
                 if down_since is None:
@@ -97,7 +108,11 @@ class _Heartbeat:
                 elif now - down_since >= self.worker.reconnect_timeout_s:
                     self.lost = True
                     return
+                wait_s = jitter.next_delay()
                 continue
+            down_since = None
+            jitter.reset()
+            wait_s = self.interval_s
             if reply.get("lost"):
                 self.lost = True
                 return
@@ -113,6 +128,7 @@ class PoolWorker:
         crash_after_chunks: int | None = None,
         simulate_crash: bool = False,
         rng=None,
+        idle_exit_s: float | None = None,
     ):
         self.socket_path = str(socket_path)
         self.worker_id = str(worker_id)
@@ -121,9 +137,15 @@ class PoolWorker:
         self.crash_after_chunks = crash_after_chunks
         self.simulate_crash = bool(simulate_crash)
         self.rng = rng
+        self.idle_exit_s = idle_exit_s
         self.units_done = 0
         self.units_lost = 0
         self._chunks_seen = 0
+        # warm compiled fleets, one per geometry bucket: keyed by
+        # (config JSON, events capacity, chunk_steps), so serve jobs in
+        # the same bucket reuse the compiled program across units — the
+        # per-worker half of the front-end's slot-bucket design
+        self._bucket_fleets: dict[tuple, object] = {}
 
     # ---- coordinator RPC with reconnect ----------------------------------
 
@@ -146,7 +168,10 @@ class PoolWorker:
 
     def run(self) -> int:
         """Lease/execute until the coordinator says the campaign is done
-        (exit 0) or stays unreachable (exit 75)."""
+        (exit 0) or stays unreachable (exit 75). With `idle_exit_s`, a
+        worker left idle that long also exits 0 — the autoscaling
+        front-end's scale-DOWN path (it respawns workers on demand)."""
+        idle_since = None
         while True:
             try:
                 reply = self._call({"verb": "lease"})
@@ -158,11 +183,18 @@ class PoolWorker:
             if reply.get("done"):
                 return 0
             if reply.get("idle"):
+                now = time.monotonic()
+                if idle_since is None:
+                    idle_since = now
+                elif (self.idle_exit_s is not None
+                      and now - idle_since >= self.idle_exit_s):
+                    return 0
                 time.sleep(
                     jittered(float(reply.get("retry_after_s", 1.0)),
                              rng=self.rng)
                 )
                 continue
+            idle_since = None
             self.run_unit(reply)
 
     # ---- unit execution --------------------------------------------------
@@ -217,6 +249,25 @@ class PoolWorker:
         finally:
             hb.stop()
 
+    def _bucket_fleet(self, unit, cfg):
+        """The warm compiled slot fleet for a unit's geometry bucket
+        (`capacity_pages` units = serve jobs dispatched by the elastic
+        front-end). Compiled once per (config, capacity, chunk_steps)
+        and reused across every unit in the bucket — `replace_element`
+        splices workloads without recompiling."""
+        from ..serve.scheduler import PAGE_EVENTS
+        from ..sim.fleet import FleetEngine
+
+        cap = int(unit["capacity_pages"]) * PAGE_EVENTS
+        key = (unit["config"], cap, int(unit["chunk_steps"]))
+        fleet = self._bucket_fleets.get(key)
+        if fleet is None:
+            fleet = FleetEngine.make_slots(
+                cfg, 1, cap, chunk_steps=int(unit["chunk_steps"])
+            )
+            self._bucket_fleets[key] = fleet
+        return fleet
+
     def _simulate_leased(self, grant, unit, unit_id, ckpt_path,
                          hb) -> tuple[dict, int]:
         from ..config.machine import MachineConfig
@@ -234,10 +285,15 @@ class PoolWorker:
             trace = Trace.load(unit["trace_path"])
             if unit["fold"]:
                 trace = fold_ins(trace)
-        fleet = FleetEngine(
-            cfg, [trace], [dict(unit["overrides"])],
-            chunk_steps=int(unit["chunk_steps"]),
-        )
+        bucketed = unit.get("capacity_pages") is not None
+        if bucketed:
+            fleet = self._bucket_fleet(unit, cfg)
+            fleet.replace_element(0, trace, override=dict(unit["overrides"]))
+        else:
+            fleet = FleetEngine(
+                cfg, [trace], [dict(unit["overrides"])],
+                chunk_steps=int(unit["chunk_steps"]),
+            )
 
         resumed_steps = 0
         if grant.get("checkpoint"):
@@ -272,7 +328,20 @@ class PoolWorker:
 
         sup = RunSupervisor(fleet, handle_signals=False, on_chunk=on_chunk)
         t0 = time.perf_counter()
-        sup.run(max_steps=int(unit["max_steps"]))
+        try:
+            sup.run(max_steps=int(unit["max_steps"]))
+        except BaseException:
+            if bucketed:
+                # evict the failed workload so the warm fleet is clean
+                # for the next unit in this bucket
+                try:
+                    fleet.clear_element(0)
+                except Exception:
+                    self._bucket_fleets.pop(
+                        (unit["config"],
+                         fleet.events_capacity,
+                         int(unit["chunk_steps"])), None)
+            raise
         wall = time.perf_counter() - t0
 
         # the per-element record, byte-for-byte the shape `primetpu
@@ -294,6 +363,20 @@ class PoolWorker:
                 "noc_msgs": int(ec["noc_msgs"].sum()),
             },
         }
+        if unit.get("serve_job"):
+            # the front-end maps this into the serve job's result and
+            # bit-exactness tests diff it against a solo Engine run —
+            # extend ONLY for serve units so sweep records stay
+            # byte-identical for the pool-chaos CI diff
+            result["detail"]["core_cycles"] = [
+                int(c) for c in fleet.cycles[0]
+            ]
+            result["detail"]["steps"] = int(fleet.steps_run[0])
+            result["detail"]["counters"] = {
+                k: [int(x) for x in v] for k, v in ec.items()
+            }
+        if bucketed:
+            fleet.clear_element(0)
         return result, resumed_steps
 
     def _checkpoint(self, path: str, fleet, unit_id: str) -> None:
@@ -348,6 +431,7 @@ def run_worker(
     warm_cache: bool = False,
     reconnect_timeout_s: float = 60.0,
     crash_after_chunks: int | None = None,
+    idle_exit_s: float | None = None,
 ) -> int:
     return PoolWorker(
         socket_path,
@@ -355,4 +439,5 @@ def run_worker(
         warm_cache=warm_cache,
         reconnect_timeout_s=reconnect_timeout_s,
         crash_after_chunks=crash_after_chunks,
+        idle_exit_s=idle_exit_s,
     ).run()

@@ -1,13 +1,19 @@
 """Client for a running `primetpu serve` daemon — thin verb wrappers over
 the JSON-lines protocol, used by `primetpu submit` / `primetpu
-serve-status` and directly by tests."""
+serve-status` and directly by tests.
+
+Targets are either a unix-socket path or `host:port` (the TCP
+front-end). Connects are bounded by `connect_timeout_s` and retried
+ONCE on a connect-phase failure (`ServeUnavailable` — nothing was sent,
+so the retry cannot double-submit) before the service is reported down;
+post-send failures propagate immediately."""
 
 from __future__ import annotations
 
 import time
 
 from ..util.backoff import jittered
-from .protocol import request
+from .protocol import ServeUnavailable, request
 
 
 class ServeError(RuntimeError):
@@ -23,18 +29,31 @@ class ServeError(RuntimeError):
 
 
 class ServeClient:
-    def __init__(self, socket_path: str, timeout_s: float = 30.0):
-        self.socket_path = str(socket_path)
+    def __init__(self, target: str, timeout_s: float = 30.0,
+                 connect_timeout_s: float = 5.0):
+        self.target = str(target)
+        self.socket_path = self.target  # legacy alias (pre-TCP callers)
         self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
 
     def _call(self, req: dict, timeout_s: float | None = None) -> dict:
-        reply = request(
-            self.socket_path, req,
-            timeout_s=self.timeout_s if timeout_s is None else timeout_s,
-        )
+        try:
+            reply = self._request(req, timeout_s)
+        except ServeUnavailable:
+            # connect never completed: one jittered retry before the
+            # service is declared down (front-end failover window)
+            time.sleep(jittered(0.2))
+            reply = self._request(req, timeout_s)
         if not reply.get("ok", False):
             raise ServeError(reply)
         return reply
+
+    def _request(self, req: dict, timeout_s: float | None) -> dict:
+        return request(
+            self.target, req,
+            timeout_s=self.timeout_s if timeout_s is None else timeout_s,
+            connect_timeout_s=self.connect_timeout_s,
+        )
 
     def submit(
         self,

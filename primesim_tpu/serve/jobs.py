@@ -73,6 +73,11 @@ class Job:
     _elem_cfg: object = None
     _ctx: object = None
     _resume_from: str | None = None
+    # v2 paged allocator: the truncated WINDOW trace currently spliced
+    # into a small bucket (None = the full trace is resident), plus a
+    # cached has-sync flag (sync events pin a trace to full residency)
+    _window: object = None
+    _has_sync: bool | None = None
 
     # ---- state machine ---------------------------------------------------
 

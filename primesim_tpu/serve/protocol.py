@@ -1,4 +1,7 @@
-"""Wire protocol for `primetpu serve` — JSON lines over a unix socket.
+"""Wire protocol for `primetpu serve` — JSON lines over a unix socket
+or a TCP listener (DESIGN.md §18: the elastic front-end admits many
+concurrent clients over `--tcp HOST:PORT`; the unix socket stays for
+single-host compat).
 
 Each request and each reply is one JSON object on one line (UTF-8,
 newline-terminated). Requests carry a `verb`; replies carry `ok: bool`
@@ -32,6 +35,51 @@ import json
 import socket
 
 MAX_LINE = 1 << 20  # 1 MiB per message — traces travel by path, not value
+
+
+class ServeUnavailable(ConnectionError):
+    """Connect-phase failure: nothing was sent, so the caller may retry
+    the SAME request without double-submitting. Post-send failures stay
+    plain ConnectionError — retrying those could duplicate a submit."""
+
+
+def parse_target(target) -> tuple[str, object]:
+    """Classify a service target string: `("tcp", (host, port))` for
+    `host:port` / `[v6::addr]:port`, else `("unix", path)`. A path can
+    contain a colon only alongside a slash, so `./sock:dir/s` stays a
+    path while `localhost:7077` is TCP."""
+    t = str(target)
+    if ":" in t and "/" not in t:
+        host, _, port = t.rpartition(":")
+        if host and port.isdigit():
+            return "tcp", (host.strip("[]"), int(port))
+    return "unix", t
+
+
+def format_target(target) -> str:
+    """Canonical display string for either target family."""
+    fam, addr = parse_target(target)
+    return f"{addr[0]}:{addr[1]}" if fam == "tcp" else str(addr)
+
+
+def _connect(target, timeout_s: float):
+    """Open a connected socket to a unix-path or host:port target.
+    Raises ServeUnavailable on ANY connect-phase failure."""
+    fam, addr = parse_target(target)
+    if fam == "tcp":
+        s = socket.socket(socket.AF_INET6 if ":" in addr[0]
+                          else socket.AF_INET, socket.SOCK_STREAM)
+    else:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout_s)
+    try:
+        s.connect(addr if fam == "tcp" else str(addr))
+    except OSError as e:
+        s.close()
+        raise ServeUnavailable(
+            f"cannot connect to {format_target(target)}: {e}"
+        ) from e
+    return s
 
 
 def error_obj(exc: BaseException) -> dict:
@@ -80,22 +128,19 @@ def read_line(f) -> dict | None:
     return decode(line)
 
 
-def socket_alive(sock_path: str, timeout_s: float = 0.5) -> bool:
-    """True when something ACCEPTS connections on `sock_path`. False for
-    a missing path or a STALE socket file — the inode a SIGKILLed daemon
-    leaves behind, which refuses connections because no process listens.
-    A connect that times out counts as alive (a bound-but-busy peer)."""
-    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+def socket_alive(target, timeout_s: float = 0.5) -> bool:
+    """True when something ACCEPTS connections on `target` (unix path or
+    host:port). False for a missing path or a STALE socket file — the
+    inode a SIGKILLed daemon leaves behind, which refuses connections
+    because no process listens. A connect that times out counts as alive
+    (a bound-but-busy peer)."""
     try:
-        s.settimeout(timeout_s)
-        s.connect(sock_path)
+        _connect(target, timeout_s).close()
         return True
-    except socket.timeout:
-        return True  # bound and backlogged — definitely not stale
-    except OSError:
+    except ServeUnavailable as e:
+        if isinstance(e.__cause__, socket.timeout):
+            return True  # bound and backlogged — definitely not stale
         return False  # ENOENT / ECONNREFUSED: absent or dead
-    finally:
-        s.close()
 
 
 def claim_socket_path(sock_path: str) -> None:
@@ -116,14 +161,46 @@ def claim_socket_path(sock_path: str) -> None:
     os.unlink(sock_path)  # stale: previous owner died without cleanup
 
 
-def request(sock_path: str, req: dict, timeout_s: float = 30.0) -> dict:
-    """One request/reply round trip against the server socket."""
-    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+def request(target, req: dict, timeout_s: float = 30.0,
+            connect_timeout_s: float | None = None) -> dict:
+    """One request/reply round trip against the server (unix path or
+    host:port). `connect_timeout_s` bounds the connect phase separately
+    (defaults to `timeout_s`); a connect failure raises ServeUnavailable
+    (retry-safe), a post-send failure plain ConnectionError (not)."""
+    s = _connect(target, connect_timeout_s
+                 if connect_timeout_s is not None else timeout_s)
+    try:
         s.settimeout(timeout_s)
-        s.connect(sock_path)
         s.sendall(encode(req))
         f = s.makefile("rb")
         reply = read_line(f)
+    finally:
+        s.close()
     if reply is None:
-        raise ConnectionError(f"server at {sock_path} closed without reply")
+        raise ConnectionError(
+            f"server at {format_target(target)} closed without reply"
+        )
     return reply
+
+
+def make_listener(target, handler_cls):
+    """A threaded line-protocol listener on either family: a
+    `ThreadingTCPServer` (SO_REUSEADDR; port 0 = kernel-assigned, read
+    the real one from `.server_address`) or a `ThreadingUnixStreamServer`
+    after `claim_socket_path`. The caller owns serve_forever/shutdown."""
+    import socketserver
+
+    fam, addr = parse_target(target)
+    if fam == "tcp":
+        class Listener(socketserver.ThreadingMixIn, socketserver.TCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        return Listener(addr, handler_cls), "tcp"
+
+    class Listener(socketserver.ThreadingMixIn,
+                   socketserver.UnixStreamServer):
+        daemon_threads = True
+
+    claim_socket_path(str(addr))
+    return Listener(str(addr), handler_cls), "unix"

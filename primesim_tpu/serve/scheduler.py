@@ -228,6 +228,9 @@ class Scheduler:
         # surface) + last-dispatch stamp (health/metrics liveness signal)
         self.latency_hist = Histogram()
         self.last_dispatch_t: float | None = None
+        # v2 paged allocator: slot migrations between capacity buckets
+        self.promotions = 0
+        self.demotions = 0
 
     def _serve_event(self, kind: str, **args) -> None:
         if self.obs is not None:
@@ -340,10 +343,19 @@ class Scheduler:
                 self._dispatch_failed(b, e)
                 return True
         self._harvest(now)
+        # promotion check runs BETWEEN chunks: a windowed job must leave
+        # its small bucket before the next chunk could reach the window
+        # edge (see _promote_windows for the pointer-bound argument)
+        self._promote_windows()
         if now - self._last_ckpt_t >= self.checkpoint_every_s:
             self.checkpoint_running()
             self._last_ckpt_t = now
         return worked
+
+    def pending_work(self) -> bool:
+        """Anything admitted but not yet terminal — the server's busy
+        signal for idle-exit and drain decisions."""
+        return bool(self.queue) or any(b.occupied for b in self.buckets)
 
     def _expire_deadlines(self, now: float) -> None:
         for job_id in list(self.queue):
@@ -390,7 +402,11 @@ class Scheduler:
     def _fill_slots(self) -> None:
         """Splice pending jobs into free slots, smallest-fitting bucket
         first; one deferred `upload_events` per bucket covers the whole
-        batch of splices."""
+        batch of splices. Two passes per bucket (v2 paged allocator):
+        full-fit jobs first, then WINDOW admissions — an oversized job's
+        leading `capacity-1` events run in the small bucket now and the
+        job migrates up by checkpoint before the window edge matters."""
+        self._demote_for_queued()
         for b in self.buckets:
             spliced = False
             while True:
@@ -407,13 +423,198 @@ class Scheduler:
                 spliced = True
             if spliced:
                 b.fleet.upload_events()
+        # window pass, all buckets — runs only after every full-fit
+        # splice, so a job starts windowed only when no bucket that fully
+        # fits it has a free slot
+        for b in self.buckets:
+            spliced = False
+            while True:
+                i = b.free_slot()
+                if i is None:
+                    break
+                job = self._pick_window(b)
+                if job is None:
+                    break
+                self.queue.remove(job.job_id)
+                self._pick_n += 1
+                self._last_pick[job.client] = self._pick_n
+                job._window = self._window_trace(job._trace, b.capacity)
+                self._place(b, i, job, upload=False)
+                spliced = True
+            if spliced:
+                b.fleet.upload_events()
+
+    # ---- v2 paged allocator: windows + bucket migration ------------------
+
+    def _window_trace(self, tr, capacity: int):
+        """The leading `capacity-1` events of each core's row, with a
+        FORCED END at index capacity-1 for every core that was truncated.
+        The promotion bound keeps every trace pointer strictly below that
+        index, so the forced END is never consumed and the windowed
+        element's state stays bit-identical to a full-trace run."""
+        from ..trace.format import EV_END, Trace
+
+        keep = capacity - 1
+        n_cores = tr.events.shape[0]
+        ev = np.zeros((n_cores, capacity, 4), np.int32)
+        ev[:, :, 0] = EV_END
+        ev[:, :keep] = tr.events[:, :keep]
+        lengths = np.where(
+            tr.lengths > keep, keep + 1, tr.lengths
+        ).astype(np.int32)
+        return Trace(ev, lengths, line_addressed=tr.line_addressed,
+                     line_bits=tr.line_bits)
+
+    def _window_ok(self, job: J.Job, b: SlotBucket) -> bool:
+        """May `job` run its leading window in bucket `b`? Requires: the
+        full trace does NOT fit b (else pass 1 handles it) but DOES fit
+        some bucket (else quarantined at admission); no checkpoint resume
+        pending (a snapshot taken past the window edge cannot replay
+        inside it); a window deep enough to outlast one chunk; and no
+        sync events — a barrier truncated out of one core's window would
+        deadlock the cores that kept it."""
+        tr = job._trace
+        if tr is None or tr.max_len <= b.capacity:
+            return False
+        if tr.max_len > self.max_capacity:
+            return False
+        if job._resume_from is not None:
+            return False
+        if b.capacity - 1 <= b.chunk_steps:
+            return False
+        if any(sb.capacity >= tr.max_len and sb.free_slot() is not None
+               for sb in self.buckets):
+            return False  # a full-fit slot is free; windowing would waste it
+        if job._has_sync is None:
+            from ..trace.format import SYNC_TYPES
+
+            job._has_sync = bool(
+                np.isin(tr.events[:, :, 0], SYNC_TYPES).any()
+            )
+        return not job._has_sync
+
+    def _pick_window(self, b: SlotBucket) -> J.Job | None:
+        """Window-admission pick: same fairness key as _pick_next, over
+        jobs whose full trace does not fit this bucket."""
+        best = None
+        best_key = None
+        for job_id in self.queue:
+            job = self.jobs[job_id]
+            if not self._window_ok(job, b):
+                continue
+            key = (
+                -job.priority,
+                self._last_pick.get(job.client, -1),
+                job.accepted_t,
+            )
+            if best_key is None or key < best_key:
+                best, best_key = job, key
+        return best
+
+    def _migrate_out(self, b: SlotBucket, i: int, job: J.Job,
+                     why: str) -> None:
+        """Checkpoint-evict a RUNNING occupant back to the queue head so
+        the next fill re-splices it elsewhere and it resumes mid-run.
+        The snapshot is fingerprinted against the FULL trace — machine
+        state is geometry-shaped, not capacity-shaped, so it restores
+        into any bucket."""
+        from ..sim.checkpoint import save_element_checkpoint
+
+        path = self.job_ckpt_path(job.job_id)
+        save_element_checkpoint(path, b.fleet, i, job_id=job.job_id,
+                                trace=job._trace)
+        b.fleet.clear_element(i)
+        b.slots[i] = None
+        job._window = None
+        job._resume_from = path
+        job.transition(J.PENDING)
+        self.queue.insert(0, job.job_id)
+        self.journal.state(
+            job.job_id, J.PENDING,
+            detail={"detail": why, "migrated": True,
+                    "from_pages": b.n_pages},
+        )
+
+    def _promote_windows(self) -> None:
+        """Migrate windowed jobs UP before the window edge can matter.
+        Bound: a chunk advances any trace pointer by at most chunk_steps
+        (one event per core per step), so promoting whenever
+        max(ptr) >= keep - chunk_steps after a chunk guarantees
+        ptr <= keep-1 always — the forced END at `keep` is never read,
+        and the promoted job resumes from state a full-trace run would
+        have produced identically."""
+        for b in self.buckets:
+            for i, job in enumerate(b.slots):
+                if job is None or job._window is None:
+                    continue
+                keep = b.capacity - 1
+                ptr = int(np.asarray(b.fleet.state.ptr)[i].max())
+                if ptr < keep - b.chunk_steps:
+                    continue
+                steps = int(b.fleet.steps_run[i])
+                self._migrate_out(
+                    b, i, job,
+                    f"promoted out of {b.n_pages}p window at event {ptr}",
+                )
+                self.promotions += 1
+                self._serve_event("promote", job_id=job.job_id,
+                                  from_pages=b.n_pages, ptr=ptr,
+                                  steps=steps)
+
+    def _demote_for_queued(self) -> None:
+        """Starvation valve (at most one migration per tick): a queued
+        job that only fits the larger buckets is blocked while they are
+        full; if one of their occupants would fully fit a FREE smaller
+        slot, checkpoint-migrate the occupant down and free the big
+        slot."""
+        blocked = None
+        for job_id in self.queue:
+            q = self.jobs[job_id]
+            if q._trace is None:
+                continue
+            fitting = [b for b in self.buckets
+                       if b.capacity >= q._trace.max_len]
+            if fitting and all(b.free_slot() is None for b in fitting):
+                blocked = q
+                break
+        if blocked is None:
+            return
+        for b in reversed(self.buckets):  # largest candidates first
+            if b.capacity < blocked._trace.max_len:
+                continue
+            for i, occ in enumerate(b.slots):
+                if occ is None or occ._window is not None:
+                    continue
+                target = next(
+                    (sb for sb in self.buckets
+                     if sb.capacity < b.capacity
+                     and sb.capacity >= occ._trace.max_len
+                     and sb.free_slot() is not None),
+                    None,
+                )
+                if target is None:
+                    continue
+                self._migrate_out(
+                    b, i, occ,
+                    f"demoted from {b.n_pages}p to {target.n_pages}p "
+                    f"to unblock {blocked.job_id}",
+                )
+                self.demotions += 1
+                self._serve_event("demote", job_id=occ.job_id,
+                                  from_pages=b.n_pages,
+                                  to_pages=target.n_pages,
+                                  unblocks=blocked.job_id)
+                return
 
     def _place(self, b: SlotBucket, i: int, job: J.Job,
                upload: bool = True) -> None:
         from ..sim.checkpoint import load_element_checkpoint
 
         b.fleet.replace_element(
-            i, job._trace, base_cfg=job._elem_cfg, upload=upload
+            i,
+            job._window if job._window is not None else job._trace,
+            base_cfg=job._elem_cfg,
+            upload=upload,
         )
         resumed = False
         warm_steps = 0
@@ -429,7 +630,10 @@ class Scheduler:
                     f"{job.job_id}: element checkpoint unusable "
                     f"({type(e).__name__}: {e}); restarting from step 0"
                 )
-        if not resumed and self.warm_root is not None:
+        if not resumed and self.warm_root is not None \
+                and job._window is None:
+            # (windowed splices skip the warm cache: a warm state's trace
+            # pointer may already sit past the window edge)
             # no mid-run checkpoint of its own: check the warm cache. The
             # content key proves the first `steps` steps of this exact
             # (trace, config) workload; fork_element reseeds the traced
@@ -477,11 +681,13 @@ class Scheduler:
             job.job_id, J.RUNNING,
             detail={"attempt": job.attempts, "resumed": resumed,
                     "warm_steps": warm_steps,
-                    "bucket_pages": b.n_pages, "slot": i},
+                    "bucket_pages": b.n_pages, "slot": i,
+                    "window": job._window is not None},
         )
         self._serve_event("dispatch", job_id=job.job_id, slot=i,
                           bucket_pages=b.n_pages, attempt=job.attempts,
-                          resumed=resumed, warm_steps=warm_steps)
+                          resumed=resumed, warm_steps=warm_steps,
+                          window=job._window is not None)
 
     def _slot_of(self, job: J.Job) -> tuple[SlotBucket, int] | None:
         for b in self.buckets:
@@ -617,9 +823,12 @@ class Scheduler:
         for b in self.buckets:
             for i, job in enumerate(b.slots):
                 if job is not None:
+                    # fingerprint the FULL trace even for windowed
+                    # elements: recovery re-materializes the full trace
+                    # and must accept this snapshot
                     save_element_checkpoint(
                         self.job_ckpt_path(job.job_id), b.fleet, i,
-                        job_id=job.job_id,
+                        job_id=job.job_id, trace=job._trace,
                     )
                     self._serve_event(
                         "checkpoint", job_id=job.job_id,
@@ -692,6 +901,8 @@ class Scheduler:
             },
             "jobs": by_state,
             "completed": self.completed,
+            "migrations": {"promotions": self.promotions,
+                           "demotions": self.demotions},
             "aggregate_mips": round(
                 self.total_instructions / wall / 1e6, 3
             ),

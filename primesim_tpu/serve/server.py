@@ -34,8 +34,15 @@ import threading
 import time
 
 from . import jobs as J
-from .journal import JobJournal, fold_records
-from .protocol import claim_socket_path, encode, error_obj, read_line
+from .journal import JobJournal, fold_records, serve_compactor
+from .protocol import (
+    encode,
+    error_obj,
+    make_listener,
+    parse_target,
+    read_line,
+)
+from .quota import QuotaExceeded, TenantQuota
 from .scheduler import DEFAULT_BUCKETS, QueueFull, Scheduler
 
 EX_TEMPFAIL = 75  # drained with work remaining; restart to continue
@@ -65,6 +72,11 @@ class PrimeServer:
         idle_exit_s: float | None = None,
         obs=None,
         warm_cache: bool = False,
+        pool_dir: str | None = None,
+        max_workers: int = 2,
+        lease_ttl_s: float = 10.0,
+        quota: TenantQuota | None = None,
+        spawn_pool: bool = True,
     ):
         self.state_dir = str(state_dir)
         os.makedirs(self.state_dir, exist_ok=True)
@@ -74,19 +86,39 @@ class PrimeServer:
         self.config_path = config_path
         self.idle_exit_s = idle_exit_s
         self.obs = obs
-        self.journal = JobJournal(self.state_dir)
+        self.quota = quota
+        self.journal = JobJournal(self.state_dir, compactor=serve_compactor)
         self.journal.obs = obs
-        self.sched = Scheduler(
-            cfg,
-            self.journal,
-            self.state_dir,
-            buckets=buckets,
-            chunk_steps=chunk_steps,
-            max_queue=max_queue,
-            checkpoint_every_s=checkpoint_every_s,
-            obs=obs,
-            warm_cache=warm_cache,
-        )
+        if pool_dir:
+            # dispatch mode: jobs run on an autoscaling worker fleet via
+            # a (spawned or adopted) pool coordinator — DESIGN.md §18
+            from .dispatch import DispatchScheduler
+
+            self.sched = DispatchScheduler(
+                cfg,
+                self.journal,
+                self.state_dir,
+                pool_dir,
+                buckets=buckets,
+                chunk_steps=chunk_steps,
+                max_queue=max_queue,
+                max_workers=max_workers,
+                lease_ttl_s=lease_ttl_s,
+                obs=obs,
+                spawn=spawn_pool,
+            )
+        else:
+            self.sched = Scheduler(
+                cfg,
+                self.journal,
+                self.state_dir,
+                buckets=buckets,
+                chunk_steps=chunk_steps,
+                max_queue=max_queue,
+                checkpoint_every_s=checkpoint_every_s,
+                obs=obs,
+                warm_cache=warm_cache,
+            )
         self.inbox: "queue.Queue[_Request]" = queue.Queue()
         self._draining = False
         self._stop = False
@@ -147,7 +179,7 @@ class PrimeServer:
                 self._draining = True
                 return {"ok": True, "draining": True}
             raise ValueError(f"unknown verb {verb!r}")
-        except QueueFull as e:
+        except (QueueFull, QuotaExceeded) as e:
             out = {"ok": False, "retry_after_s": round(e.retry_after_s, 1)}
             out.update(error_obj(e))
             return out
@@ -161,6 +193,10 @@ class PrimeServer:
             out = {"ok": False, "retry_after_s": 5.0}
             out.update(error_obj(RuntimeError("server is draining")))
             return out
+        if self.quota is not None:
+            # admission quota spends a token BEFORE a job id exists, so
+            # rejected submits leave no trace in the journal or job table
+            self.quota.admit(str(req.get("client", "anon")))
         job = J.Job(
             job_id=self.sched.next_job_id(),
             client=str(req.get("client", "anon")),
@@ -204,6 +240,10 @@ class PrimeServer:
         out = {"ok": True, "draining": self._draining}
         out.update(self.sched.stats())
         out["recovered"] = self.recovered
+        if self.quota is not None:
+            out["quota"] = {"rate": self.quota.rate,
+                            "burst": self.quota.burst,
+                            "rejections": self.quota.rejections}
         out["journal"] = {
             "appends": self.journal.appended,
             "fsync_count": self.journal.fsync_hist.count,
@@ -220,6 +260,7 @@ class PrimeServer:
         text = render_prometheus(
             self.sched, journal=self.journal,
             draining=self._draining, recovered=self.recovered,
+            quota=self.quota,
         )
         return {"ok": True, "content_type":
                 "text/plain; version=0.0.4", "text": text}
@@ -308,16 +349,20 @@ class PrimeServer:
                     except (BrokenPipeError, ValueError):
                         return
 
-        class Listener(socketserver.ThreadingMixIn,
-                       socketserver.UnixStreamServer):
-            daemon_threads = True
-            allow_reuse_address = True
+        listener, fam = make_listener(self.socket_path, Handler)
+        if fam == "tcp":
+            # --tcp HOST:0 binds an ephemeral port; expose the real one
+            host, port = listener.server_address[:2]
+            self.socket_path = f"{host}:{port}"
+        return listener
 
-        # a socket file may be left over from a SIGKILLed predecessor:
-        # probe it and unlink only if dead (claim_socket_path raises on a
-        # LIVE listener instead of stealing its socket)
-        claim_socket_path(self.socket_path)
-        return Listener(self.socket_path, Handler)
+    def bind(self) -> str:
+        """Bind the listener now (idempotent) and return the resolved
+        target — the CLI prints its readiness line from this, so a
+        `--tcp HOST:0` caller learns the kernel-assigned port."""
+        if self._srv is None:
+            self._srv = self._make_listener()
+        return self.socket_path
 
     def _wait_reply(self, req: dict) -> dict:
         """`wait` blocks the LISTENER thread (never the scheduler) by
@@ -366,7 +411,7 @@ class PrimeServer:
         the process exit code (0 all work finished, EX_TEMPFAIL=75 when
         unfinished jobs were checkpointed for the next server)."""
         self._install_signals()
-        self._srv = self._make_listener()
+        self.bind()
         t = threading.Thread(target=self._srv.serve_forever, daemon=True)
         t.start()
         idle_since = time.time()
@@ -377,9 +422,7 @@ class PrimeServer:
                     self.reload_config()
                 self._drain_inbox()
                 worked = self.sched.tick()
-                busy = worked or self.sched.queue or any(
-                    b.occupied for b in self.sched.buckets
-                )
+                busy = worked or self.sched.pending_work()
                 if busy:
                     idle_since = time.time()
                 elif self._draining:
@@ -394,11 +437,14 @@ class PrimeServer:
         finally:
             self._srv.shutdown()
             self._srv.server_close()
-            try:
-                os.unlink(self.socket_path)
-            except OSError:
-                pass
+            if parse_target(self.socket_path)[0] == "unix":
+                try:
+                    os.unlink(self.socket_path)
+                except OSError:
+                    pass
         unfinished = self.sched.drain()
+        if hasattr(self.sched, "shutdown_children"):
+            self.sched.shutdown_children()
         self._drain_inbox()  # flush replies so clients aren't left hanging
         self.journal.close()
         return EX_TEMPFAIL if unfinished else 0

@@ -349,13 +349,19 @@ def load_checkpoint(path: str, engine) -> None:
     }
 
 
-def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "") -> None:
+def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "",
+                            trace=None) -> None:
     """Snapshot ONE fleet element solo-shaped — the serving daemon's
     per-JOB checkpoint record (DESIGN.md §14). A fleet chunk boundary is
     a consistent per-element cut (elements are mutually independent), so
     the saved state can later be spliced into ANY slot of ANY serving
     fleet on the same geometry (`FleetEngine.restore_element`) and resume
-    bit-exactly — the slot number is not part of the job's identity."""
+    bit-exactly — the slot number is not part of the job's identity.
+
+    `trace` overrides the fingerprinted workload: the v2 paged allocator
+    runs a job's leading WINDOW in a small bucket while the job's
+    identity stays the FULL trace — its checkpoints must verify against
+    the trace the job will resume with, not the window splice."""
     fleet._drain()
     arrays = _state_arrays(fleet.element_state(i))
     arrays["host_counters"] = np.stack(
@@ -379,7 +385,10 @@ def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "") -> None:
             fleet.elem_cfgs[i].to_json().encode(), dtype=np.uint8
         ),
         trace_sha=np.frombuffer(
-            trace_fingerprint(fleet.traces[i]).encode(), dtype=np.uint8
+            trace_fingerprint(
+                trace if trace is not None else fleet.traces[i]
+            ).encode(),
+            dtype=np.uint8,
         ),
         **arrays,
     )

@@ -1,0 +1,625 @@
+"""Tests for unified elastic serving (DESIGN.md §18): the TCP front
+door, per-tenant quotas, segmented-journal durability (roll / chain
+verification / compaction), the v2 paged allocator's bucket migration
+(promotion + demotion) bit-exactness, the dispatch scheduler's admission
+surface, and the real-process kill matrix.
+
+Determinism discipline matches test_serve.py / test_pool.py: fast tests
+pin semantics in-process (fake clocks, no subprocesses); the kill-matrix
+acceptance tests (real SIGKILL of the front-end, the coordinator, a
+worker, and front-end+worker together, with two concurrent TCP clients)
+are @slow — tier-1 excludes them, the CI unified-chaos job runs them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from primesim_tpu.config.machine import small_test_config
+from primesim_tpu.serve import (
+    Job,
+    JobJournal,
+    JournalCorrupt,
+    Scheduler,
+    fold_records,
+)
+from primesim_tpu.serve.client import ServeClient, ServeError
+from primesim_tpu.serve.journal import serve_compactor
+from primesim_tpu.serve.protocol import ServeUnavailable, parse_target
+from primesim_tpu.serve.quota import QuotaExceeded, TenantQuota
+from primesim_tpu.serve.scheduler import QueueFull
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: 81 events/core: does NOT fit a 1-page (64-event) slot, fits 8 pages —
+#: the window-admission shape (sync-free, so windowing is legal)
+WINDOW_SYNTH = "stream:n_mem_ops=80,seed={}"
+#: 201 events/core: several pages, ~13 chunks at chunk_steps=16 — long
+#: enough that a kill lands mid-flight
+KILL_SYNTH = "stream:n_mem_ops=200,seed={}"
+
+
+def _cfg():
+    return small_test_config(4)
+
+
+def _job(i, synth, **kw):
+    return Job(job_id=f"j{i:06d}", synth=synth, **kw)
+
+
+def _run_all(sched, jobs, limit=5000):
+    n = 0
+    while not all(j.terminal for j in jobs):
+        sched.tick()
+        n += 1
+        assert n < limit, [j.state for j in jobs]
+
+
+def _solo_result(cfg, synth_spec, chunk_steps=16):
+    from primesim_tpu.serve.scheduler import parse_synth_spec
+    from primesim_tpu.sim.engine import Engine
+
+    eng = Engine(cfg, parse_synth_spec(synth_spec, cfg.n_cores, True),
+                 chunk_steps=chunk_steps)
+    eng.run()
+    return (
+        [int(c) for c in eng.cycles],
+        {k: [int(x) for x in v] for k, v in eng.counters.items()},
+    )
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---- target parsing ------------------------------------------------------
+
+
+def test_parse_target_forms():
+    assert parse_target("/tmp/x/serve.sock") == ("unix", "/tmp/x/serve.sock")
+    assert parse_target("state/serve.sock") == ("unix", "state/serve.sock")
+    assert parse_target("127.0.0.1:9000") == ("tcp", ("127.0.0.1", 9000))
+    assert parse_target("host.example:80") == ("tcp", ("host.example", 80))
+    assert parse_target("[::1]:9000") == ("tcp", ("::1", 9000))
+    # a colon does not make a TCP target unless the port parses and the
+    # string cannot be a path
+    assert parse_target("dir/with:colon")[0] == "unix"
+    assert parse_target("host:notaport")[0] == "unix"
+    assert parse_target(":9000")[0] == "unix"  # empty host
+
+
+# ---- per-tenant quotas ---------------------------------------------------
+
+
+def test_quota_token_bucket_admit_reject_refill():
+    clk = FakeClock()
+    q = TenantQuota(rate=1.0, burst=2.0, clock=clk)
+    q.admit("a")
+    q.admit("a")  # burst exhausted
+    with pytest.raises(QuotaExceeded) as ei:
+        q.admit("a")
+    assert ei.value.client == "a"
+    assert ei.value.retry_after_s == pytest.approx(1.0)
+    assert q.rejections == 1
+    # tenants are isolated: b's bucket is untouched by a's rejection
+    q.admit("b")
+    # refill is exact: after retry_after_s one token exists again
+    clk.advance(1.0)
+    q.admit("a")
+    with pytest.raises(QuotaExceeded):
+        q.admit("a")
+    assert q.rejections == 2
+
+
+def test_quota_parse_forms():
+    q = TenantQuota.parse("2")
+    assert q.rate == 2.0 and q.burst == 2.0
+    q = TenantQuota.parse("0.5:10")
+    assert q.rate == 0.5 and q.burst == 10.0
+    # rate below one token/s still gets a usable burst of one
+    assert TenantQuota.parse("0.25").burst == 1.0
+    with pytest.raises(ValueError):
+        TenantQuota.parse("0")
+    with pytest.raises(ValueError):
+        TenantQuota(rate=2.0, burst=0.5)
+
+
+def test_quota_rejection_on_the_wire(tmp_path):
+    """A drained tenant bucket surfaces as the same structured
+    retry_after_s backpressure shape QueueFull uses — over a real TCP
+    listener (the unified front door)."""
+    from primesim_tpu.serve.server import PrimeServer
+
+    server = PrimeServer(
+        _cfg(), state_dir=str(tmp_path / "srv"),
+        socket_path="127.0.0.1:0", buckets=((2, 1),), chunk_steps=16,
+        quota=TenantQuota(rate=0.001, burst=1.0),
+    )
+    # listener + inbox pump only — no tick loop, jobs just queue
+    listener = server._make_listener()
+    t = threading.Thread(target=listener.serve_forever, daemon=True)
+    t.start()
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            server._drain_inbox()
+            time.sleep(0.005)
+
+    pumper = threading.Thread(target=pump, daemon=True)
+    pumper.start()
+    try:
+        assert parse_target(server.socket_path)[0] == "tcp"
+        cli = ServeClient(server.socket_path, timeout_s=30.0)
+        cli.submit(synth=WINDOW_SYNTH.format(1), client="tenant-a")
+        with pytest.raises(ServeError) as ei:
+            cli.submit(synth=WINDOW_SYNTH.format(2), client="tenant-a")
+        assert ei.value.error["type"] == "QuotaExceeded"
+        assert ei.value.retry_after_s is not None
+        health = cli._call({"verb": "health"})
+        assert health["quota"]["rejections"] == 1
+        metrics = cli.metrics()
+        assert "primetpu_quota_rejections_total 1" in metrics
+    finally:
+        stop.set()
+        listener.shutdown()
+        listener.server_close()
+
+
+# ---- client failover window ----------------------------------------------
+
+
+def test_client_retries_connect_failure_once(monkeypatch):
+    calls = {"n": 0}
+
+    def flaky(target, req, timeout_s=30.0, connect_timeout_s=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ServeUnavailable("front-end restarting")
+        return {"ok": True, "queue_depth": 0}
+
+    monkeypatch.setattr("primesim_tpu.serve.client.request", flaky)
+    cli = ServeClient("127.0.0.1:9999", timeout_s=1.0)
+    assert cli._call({"verb": "health"})["queue_depth"] == 0
+    assert calls["n"] == 2  # exactly one retry
+
+    def down(target, req, timeout_s=30.0, connect_timeout_s=None):
+        calls["n"] += 1
+        raise ServeUnavailable("nothing listening")
+
+    calls["n"] = 0
+    monkeypatch.setattr("primesim_tpu.serve.client.request", down)
+    with pytest.raises(ServeUnavailable):
+        cli._call({"verb": "health"})
+    assert calls["n"] == 2  # one retry, then reported down
+
+
+# ---- segmented journal ---------------------------------------------------
+
+
+def _seg_files(d):
+    return sorted(f for f in os.listdir(d)
+                  if re.match(r"journal-\d{6}\.jsonl$", f))
+
+
+def test_journal_rolls_segments_and_replays_across_chain(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, segment_records=4)
+    for i in range(11):
+        j.note(f"rec{i}")
+    assert j.segments_rolled >= 2
+    assert len(_seg_files(d)) >= 2
+    recs, dropped = JobJournal(d, segment_records=4).replay()
+    assert dropped == 0
+    assert [r["msg"] for r in recs] == [f"rec{i}" for i in range(11)]
+    j.close()
+
+
+def test_journal_torn_tail_tolerated_only_in_newest_segment(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, segment_records=4)
+    for i in range(10):
+        j.note(f"rec{i}")
+    j.close()
+    # torn tail on the ACTIVE segment: dropped, not fatal
+    with open(os.path.join(d, "journal.jsonl"), "a") as f:
+        f.write('{"c": 7, "r": {"t": "no')
+    recs, dropped = JobJournal(d, segment_records=4).replay()
+    assert len(recs) == 10 and dropped == 1
+    # the SAME damage in a rolled (closed) segment is media rot
+    rolled = os.path.join(d, _seg_files(d)[0])
+    with open(rolled, "a") as f:
+        f.write('{"c": 7, "r": {"t": "no')
+    with pytest.raises(JournalCorrupt, match="closed segment"):
+        JobJournal(d, segment_records=4).replay()
+
+
+def test_journal_missing_middle_segment_raises(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, segment_records=3)
+    for i in range(12):
+        j.note(f"rec{i}")
+    j.close()
+    segs = _seg_files(d)
+    assert len(segs) >= 3
+    os.unlink(os.path.join(d, segs[1]))
+    with pytest.raises(JournalCorrupt, match="is missing"):
+        JobJournal(d, segment_records=3).replay()
+
+
+def test_journal_tampered_chain_crc_raises(tmp_path):
+    """Swapping a rolled segment for a DIFFERENT valid segment of the
+    same seq breaks the prev-CRC chain even though every line checks."""
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    for d, tag in ((d1, "x"), (d2, "y")):
+        j = JobJournal(d, segment_records=3)
+        for i in range(7):
+            j.note(f"{tag}{i}")
+        j.close()
+    seg = _seg_files(d1)[0]
+    os.replace(os.path.join(d2, seg), os.path.join(d1, seg))
+    with pytest.raises(JournalCorrupt, match="chain CRC"):
+        JobJournal(d1, segment_records=3).replay()
+
+
+def test_serve_compaction_preserves_fold(tmp_path):
+    d = str(tmp_path / "wal")
+    j = JobJournal(d, compactor=serve_compactor, segment_records=4)
+    for i in range(1, 7):
+        j.accept(_job(i, WINDOW_SYNTH.format(i)))
+        j.state(f"j{i:06d}", "RUNNING", detail={"attempt": 1})
+    j.state("j000001", "DONE", result={"cycles": 42})
+    j.state("j000002", "QUARANTINED", detail={"type": "CapacityError"})
+    before, _ = j.replay()
+    jobs_before, clean_before = fold_records(before)
+
+    kept = j.compact()
+    assert kept < len(before)
+    assert j.compactions >= 1
+    # replay through a FRESH journal: the compacted base is what a
+    # restarted front-end actually sees
+    after, dropped = JobJournal(d).replay()
+    assert dropped == 0
+    jobs_after, clean_after = fold_records(
+        [r for r in after if r.get("t") != "note"])
+    assert clean_after == clean_before
+    assert set(jobs_after) == set(jobs_before)
+    for jid, jb in jobs_before.items():
+        ja = jobs_after[jid]
+        assert (ja.state, ja.result, ja.detail) == \
+            (jb.state, jb.result, jb.detail), jid
+    j.close()
+
+
+def test_pool_compaction_preserves_fold(tmp_path):
+    from primesim_tpu.pool.units import fold_unit_records, pool_compactor
+
+    d = str(tmp_path / "ledger")
+    j = JobJournal(d, compactor=pool_compactor)
+    j.append({"t": "lease", "unit_id": "u0", "worker": "w0", "epoch": 1,
+              "key": "k0", "hedge": False})
+    j.append({"t": "expire", "unit_id": "u0", "worker": "w0", "epoch": 1})
+    j.append({"t": "lease", "unit_id": "u0", "worker": "w1", "epoch": 2,
+              "key": "k0", "hedge": False})
+    j.append({"t": "ack", "unit_id": "u0", "worker": "w1", "epoch": 2,
+              "key": "k0", "result": {"v": 1}, "resumed_steps": 5})
+    j.append({"t": "lease", "unit_id": "u1", "worker": "w0", "epoch": 1,
+              "key": "k1", "hedge": False})
+    j.append({"t": "poison", "unit_id": "u2", "key": "k2",
+              "kills": ["w0", "w1"]})
+    before, _ = j.replay()
+    units_before, clean_before = fold_unit_records(before)
+
+    j.compact()
+    after, dropped = JobJournal(d).replay()
+    assert dropped == 0
+    units_after, clean_after = fold_unit_records(
+        [r for r in after if r.get("t") != "note"])
+    assert clean_after == clean_before
+    assert units_after == units_before
+    j.close()
+
+
+# ---- v2 paged allocator: window admission + bucket migration -------------
+
+
+def _sched(tmp_path, name, buckets, chunk_steps=16):
+    d = str(tmp_path / name)
+    return Scheduler(_cfg(), JobJournal(d), d, buckets=buckets,
+                     chunk_steps=chunk_steps, max_queue=16,
+                     checkpoint_every_s=0.0)
+
+
+def test_window_promotion_bit_exact(tmp_path):
+    """A job too long for the only free slot is window-admitted there,
+    then PROMOTED to a full-size slot (element-checkpoint migration)
+    before its pointer can reach the truncated window edge — no
+    quarantine, no re-simulated chunks, results bit-exact."""
+    sched = _sched(tmp_path, "promo", buckets=((1, 1), (1, 8)))
+    blocker = _job(1, WINDOW_SYNTH.format(1))
+    windowed = _job(2, WINDOW_SYNTH.format(2))
+    sched.submit(blocker)
+    sched.submit(windowed)
+    sched.tick()
+    # blocker owns the only full-fit slot; the second job is windowed
+    # into the 1-page bucket instead of waiting
+    assert sched.buckets[1].slots[0] is blocker
+    assert sched.buckets[0].slots[0] is windowed
+    assert windowed._window is not None
+    _run_all(sched, [blocker, windowed])
+    assert sched.promotions >= 1
+    assert sched.stats()["migrations"]["promotions"] == sched.promotions
+    for j in (blocker, windowed):
+        assert j.state == "DONE", (j.job_id, j.state, j.detail)
+        cyc, ctr = _solo_result(sched.cfg, j.synth)
+        assert j.result["core_cycles"] == cyc
+        assert j.result["counters"] == ctr
+
+
+def test_demotion_unblocks_queued_job_bit_exact(tmp_path):
+    """A small job squatting in the big bucket is DEMOTED into a free
+    small slot when a queued job fits nowhere else — both finish
+    bit-exact (the demoted one resumes from its migration checkpoint)."""
+    sched = _sched(tmp_path, "demo", buckets=((1, 1), (1, 8)),
+                   chunk_steps=8)
+    tiny = _job(1, "stream:n_mem_ops=10,seed=1")   # 11 events, 2 chunks
+    small = _job(2, "stream:n_mem_ops=60,seed=2")  # 61 events, 1 page
+    sched.submit(tiny)
+    sched.submit(small)
+    sched.tick()
+    assert sched.buckets[0].slots[0] is tiny
+    assert sched.buckets[1].slots[0] is small  # full-fit beats waiting
+    n = 0
+    while not tiny.terminal:
+        sched.tick()
+        n += 1
+        assert n < 100
+    assert not small.terminal  # 8x the work: still mid-flight
+
+    large = _job(3, KILL_SYNTH.format(3))  # only fits the 8-page bucket
+    sched.submit(large)
+    _run_all(sched, [tiny, small, large])
+    assert sched.demotions >= 1
+    assert sched.stats()["migrations"]["demotions"] == sched.demotions
+    for j in (tiny, small, large):
+        assert j.state == "DONE", (j.job_id, j.state, j.detail)
+        cyc, ctr = _solo_result(sched.cfg, j.synth, chunk_steps=8)
+        assert j.result["core_cycles"] == cyc
+        assert j.result["counters"] == ctr
+
+
+# ---- dispatch scheduler admission (no processes) -------------------------
+
+
+def test_dispatch_scheduler_admission_and_stats(tmp_path):
+    from primesim_tpu.serve.dispatch import DispatchScheduler
+
+    d = str(tmp_path / "fe")
+    sched = DispatchScheduler(
+        _cfg(), JobJournal(d, compactor=serve_compactor), d,
+        str(tmp_path / "pool"), buckets=((6, 1), (2, 8)), chunk_steps=16,
+        max_queue=2, max_workers=3, lease_ttl_s=5.0, spawn=False,
+    )
+    ok = _job(1, WINDOW_SYNTH.format(1))
+    sched.submit(ok)
+    assert ok.state == "PENDING" and list(sched.queue) == ["j000001"]
+    # the unit spec is self-contained: a worker needs nothing else
+    spec = sched._unit_spec(ok)
+    assert spec["serve_job"] and spec["unit_id"] == "j000001"
+    assert spec["capacity_pages"] == 8  # smallest ladder page that fits
+    assert spec["key"]
+
+    big = _job(2, "stream:n_mem_ops=600,seed=2")  # 601 > 8 pages
+    sched.submit(big)
+    assert big.state == "QUARANTINED"
+    assert big.detail["type"] == "CapacityError"
+
+    sched.submit(_job(3, WINDOW_SYNTH.format(3)))
+    with pytest.raises(QueueFull):
+        sched.submit(_job(4, WINDOW_SYNTH.format(4)))
+
+    # spawn=False: ticking must not fork anything nor mark progress
+    assert sched.tick() is False
+    assert sched.pending_work()
+    s = sched.stats()
+    assert s["workers"] == {"live": 0, "max": 3, "spawned": 0,
+                            "coordinator_adopted": False}
+    assert s["dispatched"] == 0
+    assert s["slots"]["total"] == 3 and s["slots"]["buckets"] == []
+
+    cancelled = sched.cancel("j000003")
+    assert cancelled.state == "CANCELLED"
+    assert sched.drain() == 1  # the one job still queued
+    sched.journal.close()
+
+
+# ---- kill matrix (real processes, real SIGKILL, concurrent TCP) ----------
+
+
+def _write_cfg(tmp_path):
+    p = str(tmp_path / "cfg.json")
+    with open(p, "w") as f:
+        f.write(_cfg().to_json())
+    return p
+
+
+def _spawn_frontend(tmp_path, tag, extra=()):
+    cfg_path = _write_cfg(tmp_path)
+    err_path = str(tmp_path / f"{tag}.stderr")
+    argv = [sys.executable, "-m", "primesim_tpu.cli", "serve", cfg_path,
+            "--state-dir", str(tmp_path / "state"),
+            "--tcp", "127.0.0.1:0",
+            "--pool-dir", str(tmp_path / "pool"),
+            "--workers", "2", "--chunk-steps", "16",
+            "--lease-ttl", "2.0", "--quota", "100",
+            "--idle-exit", "20", *extra]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(argv, cwd=REPO, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=open(err_path, "w"))
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"front-end {tag} died at startup: "
+                + open(err_path).read()[-2000:]
+            )
+        m = re.search(r"serve: listening on (\S+)",
+                      open(err_path).read())
+        if m:
+            return proc, m.group(1)
+        time.sleep(0.1)
+    raise AssertionError(f"front-end {tag} never became ready")
+
+
+def _worker_pids(pool_sock):
+    """Pool-worker processes attached to this campaign's socket, found
+    the way an operator would: /proc cmdline scan (no psutil dep)."""
+    pids = []
+    for p in os.listdir("/proc"):
+        if not p.isdigit() or int(p) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{p}/cmdline", "rb") as f:
+                parts = f.read().decode(errors="replace").split("\x00")
+        except OSError:
+            continue
+        if "worker" in parts and pool_sock in parts:
+            pids.append(int(p))
+    return sorted(pids)
+
+
+def _kill_quietly(pid, sig=signal.SIGKILL):
+    try:
+        os.kill(pid, sig)
+    except (OSError, ProcessLookupError):
+        pass
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "mode", ["frontend", "coordinator", "worker", "frontend_worker"])
+def test_unified_kill9_matrix(tmp_path, mode):
+    """The unified-serving acceptance property: kill -9 of ANY process
+    in the stack — front-end, coordinator, worker, or front-end+worker
+    together — loses no ACKed job. Two concurrent TCP clients submit;
+    after the kill (and, for front-end kills, a standby takeover on the
+    same state/pool dirs) every job reaches DONE bit-exact vs a solo
+    Engine run, and the durable journals show the failover happened."""
+    specs = [KILL_SYNTH.format(i) for i in range(4)]
+    pool_dir = str(tmp_path / "pool")
+    pool_sock = os.path.join(pool_dir, "pool.sock")
+    pid_path = os.path.join(pool_dir, "coordinator.pid")
+    proc, target = _spawn_frontend(tmp_path, "fe1")
+    live = [proc]
+    try:
+        # two concurrent TCP clients, two submits each — every returned
+        # job_id is an ACK (durably journaled before the reply)
+        ids = [None] * 4
+        errs = []
+
+        def client_thread(k):
+            try:
+                cli = ServeClient(target, timeout_s=60.0)
+                for i in (k, k + 2):
+                    ids[i] = cli.submit(
+                        synth=specs[i], client=f"tenant{k}")["job_id"]
+            except Exception as e:  # surfaced after join
+                errs.append(e)
+
+        threads = [threading.Thread(target=client_thread, args=(k,))
+                   for k in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        assert all(ids), ids
+
+        cli = ServeClient(target, timeout_s=60.0)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if any(j["state"] == "RUNNING" for j in cli.status()):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("no job ever started running")
+
+        if mode in ("worker", "frontend_worker"):
+            wdeadline = time.time() + 120
+            wpids = _worker_pids(pool_sock)
+            while time.time() < wdeadline and not wpids:
+                time.sleep(0.2)
+                wpids = _worker_pids(pool_sock)
+            assert wpids, "no pool-worker process appeared"
+            _kill_quietly(wpids[0])
+        if mode == "coordinator":
+            coord_pid = int(open(pid_path).read())
+            _kill_quietly(coord_pid)
+        if mode in ("frontend", "frontend_worker"):
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=60)
+            # standby takeover: same state dir, same pool dir, new port;
+            # the coordinator (and its leases) outlived the front-end
+            proc2, target = _spawn_frontend(tmp_path, "fe2")
+            live.append(proc2)
+            cli = ServeClient(target, timeout_s=60.0)
+
+        results = {i: cli.wait(i, timeout_s=420.0) for i in ids}
+        for spec, i in zip(specs, ids):
+            assert results[i]["state"] == "DONE", (mode, i, results[i])
+            cyc, ctr = _solo_result(_cfg(), spec)
+            assert results[i]["result"]["core_cycles"] == cyc
+            assert results[i]["result"]["counters"] == ctr
+
+        # let the surviving front-end drain out via --idle-exit
+        rc = live[-1].wait(timeout=180)
+        assert rc == 0
+
+        # failover evidence in the durable artifacts
+        pool_recs, _ = JobJournal(pool_dir).replay()
+        if mode == "coordinator":
+            # a fresh coordinator (empty ledger) journals no recovery
+            # note; the restarted one replays the units and says so
+            recovers = [r for r in pool_recs if r.get("t") == "note"
+                        and "pool recovered" in r.get("msg", "")
+                        and "'ledger_records': 0" not in r.get("msg", "")]
+            assert recovers, "no coordinator restart journaled"
+            assert os.path.exists(pid_path) is False or \
+                int(open(pid_path).read()) != coord_pid
+        if mode in ("worker", "frontend_worker"):
+            assert any(r.get("t") == "expire" for r in pool_recs), \
+                "worker kill never surfaced as a lease expiry"
+        if mode in ("frontend", "frontend_worker"):
+            serve_recs, _ = JobJournal(str(tmp_path / "state")).replay()
+            assert any(r.get("t") == "note"
+                       and "adopted live coordinator" in r.get("msg", "")
+                       for r in serve_recs), \
+                "standby never journaled the coordinator adoption"
+    finally:
+        for p in live:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        try:
+            _kill_quietly(int(open(pid_path).read()), signal.SIGTERM)
+        except (OSError, ValueError):
+            pass
+        for pid in _worker_pids(pool_sock):
+            _kill_quietly(pid, signal.SIGTERM)
