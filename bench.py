@@ -66,14 +66,20 @@ def _measure(cfg, trace, chunk: int, runs: int = 3):
         has_sync=warm.has_sync,
     )
     np.asarray(out[0].cycles)  # block until compiled
+    from primesim_tpu.analysis.recompile import recompile_sentinel
+
     walls = []
     eng = None
-    for _ in range(runs):
-        eng = Engine(cfg, trace, chunk_steps=chunk)
-        eng.block_until_ready()  # don't bill async uploads to simulation
-        t0 = time.perf_counter()
-        eng.run(max_steps=10_000_000)
-        walls.append(time.perf_counter() - t0)
+    # the timed loop re-runs the already-compiled program; any compile
+    # in here is a jit-key regression AND a corrupted measurement
+    with recompile_sentinel(allowed=0, watch=("engine",),
+                            label="bench solo timed loop"):
+        for _ in range(runs):
+            eng = Engine(cfg, trace, chunk_steps=chunk)
+            eng.block_until_ready()  # don't bill async uploads
+            t0 = time.perf_counter()
+            eng.run(max_steps=10_000_000)
+            walls.append(time.perf_counter() - t0)
     return eng, min(walls), walls
 
 
@@ -92,13 +98,17 @@ def _measure_fleet(cfg, traces, chunk: int, runs: int = 2) -> float:
         jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
     )
     np.asarray(out[0].cycles)  # block until compiled
+    from primesim_tpu.analysis.recompile import recompile_sentinel
+
     walls = []
-    for _ in range(runs):
-        fl = FleetEngine(cfg, traces, chunk_steps=chunk)
-        fl.block_until_ready()
-        t0 = time.perf_counter()
-        fl.run(max_steps=10_000_000)
-        walls.append(time.perf_counter() - t0)
+    with recompile_sentinel(allowed=0, watch=("fleet",),
+                            label="bench fleet timed loop"):
+        for _ in range(runs):
+            fl = FleetEngine(cfg, traces, chunk_steps=chunk)
+            fl.block_until_ready()
+            t0 = time.perf_counter()
+            fl.run(max_steps=10_000_000)
+            walls.append(time.perf_counter() - t0)
     return min(walls)
 
 
@@ -318,8 +328,11 @@ def main() -> None:
 
         _campaign(False)  # compile the fleet program
         _campaign(True)  # compile the solo prefix program
-        wall_unforked = min(_campaign(False)[0] for _ in range(2))
-        forked_runs = [_campaign(True) for _ in range(2)]
+        from primesim_tpu.analysis.recompile import recompile_sentinel
+
+        with recompile_sentinel(allowed=0, label="bench fork campaign"):
+            wall_unforked = min(_campaign(False)[0] for _ in range(2))
+            forked_runs = [_campaign(True) for _ in range(2)]
         wall_forked = min(w for w, _ in forked_runs)
         fork_speedup = wall_unforked / wall_forked
         fork_detail = {

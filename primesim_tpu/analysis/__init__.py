@@ -1,0 +1,19 @@
+"""Static analysis subsystem (DESIGN.md §19): `primetpu lint` checks
+the SOURCE against the repo's invariant catalog, `primetpu fsck`
+checks DURABLE ARTIFACTS (journals, ledgers, checkpoints, warm cache)
+with zero simulation, and `recompile_sentinel` guards the one-compile-
+per-geometry contract at runtime."""
+
+from .errors import AnalysisError, FsckCorrupt, RecompileError
+from .fsck import run_fsck
+from .lint import run_lint
+from .recompile import recompile_sentinel
+
+__all__ = [
+    "AnalysisError",
+    "FsckCorrupt",
+    "RecompileError",
+    "run_fsck",
+    "run_lint",
+    "recompile_sentinel",
+]

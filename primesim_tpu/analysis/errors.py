@@ -1,0 +1,62 @@
+"""Typed errors for the analysis subsystem (DESIGN.md §19).
+
+Both verbs ride the existing CLI error contract: `primetpu` catches
+these in `main()` and prints `{"error": {type, location, detail}}` on
+stderr with exit code 2, exactly like TraceError / FaultConfigError /
+CheckpointCorrupt. `location()` follows the same shape those errors
+use: a small dict of wherever the problem is anchored.
+"""
+
+from __future__ import annotations
+
+
+class AnalysisError(ValueError):
+    """The analysis itself failed (unparseable source, malformed
+    baseline, bad rule selection) — distinct from "findings exist",
+    which is a normal exit-1 outcome for `primetpu lint`."""
+
+    def __init__(self, msg: str, *, path: str | None = None,
+                 line: int | None = None):
+        super().__init__(msg)
+        self.path = path
+        self.line = line
+
+    def location(self) -> dict:
+        loc: dict = {}
+        if self.path is not None:
+            loc["path"] = self.path
+        if self.line is not None:
+            loc["line"] = self.line
+        return loc
+
+
+class FsckCorrupt(ValueError):
+    """`primetpu fsck` found corruption in durable state: a broken CRC
+    chain, an illegal state-machine transition, a checkpoint that fails
+    its manifest, a warm-cache entry whose key disagrees with its
+    content. Carries the first corrupt path plus the total count."""
+
+    def __init__(self, msg: str, *, path: str | None = None,
+                 n_corrupt: int = 0):
+        super().__init__(msg)
+        self.path = path
+        self.n_corrupt = n_corrupt
+
+    def location(self) -> dict:
+        loc: dict = {"n_corrupt": self.n_corrupt}
+        if self.path is not None:
+            loc["path"] = self.path
+        return loc
+
+
+class RecompileError(AnalysisError):
+    """The runtime recompile sentinel saw a jitted entry point compile
+    more than its budget inside the guarded region — the jit-key
+    invariant (one compilation per geometry, knobs traced) regressed."""
+
+    def __init__(self, msg: str, *, growth: dict | None = None):
+        super().__init__(msg)
+        self.growth = dict(growth or {})
+
+    def location(self) -> dict:
+        return {"growth": self.growth}

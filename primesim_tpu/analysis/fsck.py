@@ -1,0 +1,568 @@
+"""`primetpu fsck` — static verification of durable state.
+
+Walks a directory tree and validates every durable artifact the repo
+writes, with ZERO simulation and without mutating anything it checks:
+
+  - journal/ledger segment chains (serve/journal.py): per-line frame
+    CRCs, torn-tail-only-in-the-newest-segment, header seq agreement,
+    sequence contiguity, the rolled-segment prev-CRC back-links, and
+    base-segment restarts — a read-only reimplementation of
+    `JobJournal.replay()` that reports findings instead of raising
+    (and, crucially, never instantiates JobJournal: its constructor
+    repairs crash debris, which would destroy the evidence)
+  - serve job records: state-machine legality of the journaled
+    transition stream under the fold's documented tolerances
+    (duplicate accepts, post-terminal duplicates, RUNNING->PENDING
+    crash re-admission)
+  - pool ledger records: unit-key consistency — every lease/ack/spec
+    key for one unit must agree, and a `unit` spec must hash to its
+    own stamped key
+  - checkpoints (*.npz): CRC manifest via `load_verified_npz`,
+    `_FORMAT` version, per-kind required members, counter-row counts
+  - warm-cache entries: sidecar↔filename↔npz agreement (key stem,
+    steps, trace_sha); orphan sidecars and mkstemp leftovers are
+    reported as notes, not corruption (they are expected kill -9
+    debris)
+
+`--repair quarantine` moves (never deletes) corrupt or orphaned FILES
+into `<root>/.fsck-quarantine/<relpath>`; logical findings that span a
+chain (an illegal transition inside an intact segment) are reported
+but not repairable. Exit codes ride the CLI contract: 0 clean (notes
+allowed — crash debris is normal), 2 with structured JSON when any
+corrupt finding exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+
+from .errors import FsckCorrupt
+
+_JOURNAL_ACTIVE = "journal.jsonl"
+_SERVE_TYPES = {"accept", "state"}
+_POOL_TYPES = {"unit", "lease", "expire", "ack", "poison"}
+
+
+@dataclasses.dataclass
+class Finding:
+    kind: str        # "journal-chain" | "journal-record" | "job-transition"
+    #                  | "ledger-key" | "checkpoint" | "warm-cache" | "orphan"
+    path: str        # root-relative
+    detail: str
+    corrupt: bool    # True -> fsck exits 2
+    repairable: bool = False  # a file quarantine can move aside
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FsckResult:
+    root: str
+    findings: list
+    checked: dict      # category -> count
+    quarantined: list  # root-relative paths moved aside
+
+    @property
+    def corrupt(self) -> list:
+        return [f for f in self.findings if f.corrupt]
+
+    @property
+    def clean(self) -> bool:
+        return not self.corrupt
+
+
+# ---- journal chain ------------------------------------------------------
+
+
+def _scan_lines_ro(path: str) -> list:
+    """Like journal._scan_lines but byte-tolerant: undecodable bytes
+    (media rot inside a segment) must surface as CRC findings, not
+    crash the checker. Replacement characters guarantee the framed
+    line's CRC fails, which is exactly the right diagnosis."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return [ln for ln in f.read().splitlines() if ln.strip()]
+
+
+def _parse_segment_ro(path: str, rel: str, newest: bool):
+    """Read-only mirror of JobJournal._parse_segment: one segment ->
+    (header, records, last_line_crc, findings, torn_dropped)."""
+    from ..serve.journal import _line_crc, _unframe
+
+    lines = _scan_lines_ro(path)
+    header = None
+    records: list = []
+    last_crc = 0
+    bad_at = None
+    findings: list = []
+    for n, line in enumerate(lines):
+        rec = _unframe(line)
+        if rec is None:
+            if not newest:
+                findings.append(Finding(
+                    "journal-record", rel,
+                    f"line {n + 1} fails its frame CRC in a CLOSED "
+                    "segment — media rot, not a torn append",
+                    corrupt=True, repairable=True,
+                ))
+                continue
+            if bad_at is None:
+                bad_at = n
+            continue
+        if bad_at is not None:
+            findings.append(Finding(
+                "journal-record", rel,
+                f"line {bad_at + 1} fails its frame CRC but line "
+                f"{n + 1} is valid — mid-file corruption, not a torn "
+                "tail", corrupt=True, repairable=True,
+            ))
+            bad_at = None
+        if n == 0 and isinstance(rec, dict) and rec.get("t") == "seg":
+            header = rec
+        elif isinstance(rec, dict):
+            records.append(rec)
+        last_crc = _line_crc(line)
+    dropped = 0
+    if bad_at is not None:
+        dropped = len(lines) - bad_at
+        findings.append(Finding(
+            "journal-record", rel,
+            f"torn tail: {dropped} unfinished line(s) at the end of "
+            "the newest segment (normal kill -9 debris; replay drops "
+            "them)", corrupt=False,
+        ))
+    return header, records, last_crc, findings, dropped
+
+
+def _check_journal_dir(dirpath: str, root: str) -> tuple:
+    """Verify one journal directory's segment chain; returns
+    (records, findings). Mirrors JobJournal.replay() ordering/base
+    semantics without opening anything for write."""
+    from ..serve.journal import _SEG_RE
+
+    rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+    findings: list = []
+    rolled = []
+    for name in os.listdir(dirpath):
+        m = _SEG_RE.match(name)
+        if m:
+            rolled.append((int(m.group(1)), os.path.join(dirpath, name)))
+    rolled.sort()
+    segments = list(rolled)
+    active = os.path.join(dirpath, _JOURNAL_ACTIVE)
+    if os.path.exists(active):
+        from ..serve.journal import _unframe
+
+        active_seq = rolled[-1][0] + 1 if rolled else 0
+        lines = _scan_lines_ro(active)
+        if lines:
+            first = _unframe(lines[0])
+            if first is not None and first.get("t") == "seg":
+                active_seq = int(first.get("seq", active_seq))
+        segments.append((active_seq, active))
+    if not segments:
+        return [], findings
+
+    parsed = []
+    for seq, path in segments:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        newest = path == segments[-1][1]
+        header, records, last_crc, segfinds, dropped = _parse_segment_ro(
+            path, rel, newest
+        )
+        findings.extend(segfinds)
+        if header is not None and int(header.get("seq", seq)) != seq:
+            findings.append(Finding(
+                "journal-chain", rel,
+                f"segment header claims seq {header.get('seq')} but "
+                f"sits at chain position {seq} (renamed or transplanted "
+                "segment)", corrupt=True, repairable=True,
+            ))
+        parsed.append((seq, path, rel, header, records, last_crc))
+
+    # replay starts at the newest BASE segment (compaction snapshot)
+    start = 0
+    for i, (_, _, _, header, _, _) in enumerate(parsed):
+        if header is not None and header.get("base"):
+            start = i
+    parsed = parsed[start:]
+
+    for k in range(1, len(parsed)):
+        prev_seq, _, _, _, _, prev_crc = parsed[k - 1]
+        seq, _, rel, header, _, _ = parsed[k]
+        if seq != prev_seq + 1:
+            findings.append(Finding(
+                "journal-chain", rel_dir,
+                f"segment {prev_seq + 1} is missing from the chain "
+                f"(found {seq} after {prev_seq})", corrupt=True,
+            ))
+        if header is None:
+            findings.append(Finding(
+                "journal-chain", rel,
+                f"segment {seq} has no header but is not the base of "
+                "the chain", corrupt=True, repairable=True,
+            ))
+        elif int(header.get("prev", -1)) != prev_crc:
+            findings.append(Finding(
+                "journal-chain", rel,
+                f"segment {seq} back-link CRC mismatch — the preceding "
+                "segment is not the one this was rolled from (tampered "
+                "or transplanted chain)", corrupt=True, repairable=True,
+            ))
+
+    records: list = []
+    for _, _, _, _, recs, _ in parsed:
+        records.extend(recs)
+    return records, findings
+
+
+# ---- record-stream legality --------------------------------------------
+
+
+def _check_serve_records(records: list, rel_dir: str) -> list:
+    """Job state-machine legality under the fold's tolerances."""
+    from ..serve.jobs import _LEGAL, STATES, TERMINAL_STATES, Job
+
+    findings: list = []
+    state: dict = {}
+    for rec in records:
+        t = rec.get("t")
+        if t == "accept":
+            job = rec.get("job") or {}
+            try:
+                Job.from_accept_record(dict(job))
+            except (TypeError, ValueError) as e:
+                findings.append(Finding(
+                    "job-transition", rel_dir,
+                    f"unparseable accept record "
+                    f"({job.get('job_id', '?')}): {e}", corrupt=True,
+                ))
+                continue
+            state.setdefault(str(job.get("job_id")), "PENDING")
+        elif t == "state":
+            jid = str(rec.get("job_id"))
+            new = rec.get("state")
+            if new not in STATES:
+                findings.append(Finding(
+                    "job-transition", rel_dir,
+                    f"job {jid}: unknown state {new!r}", corrupt=True,
+                ))
+                continue
+            cur = state.get(jid)
+            if cur is None:
+                findings.append(Finding(
+                    "job-transition", rel_dir,
+                    f"job {jid}: state record with no accept record in "
+                    "the chain (lost acceptance)", corrupt=True,
+                ))
+                state[jid] = new
+                continue
+            # fold tolerances: terminal-is-forever swallows everything
+            # after the first terminal; exact-duplicate states are
+            # redispatch/hedge echoes
+            if cur in TERMINAL_STATES or new == cur:
+                continue
+            if new not in _LEGAL.get(cur, ()):
+                findings.append(Finding(
+                    "job-transition", rel_dir,
+                    f"job {jid}: illegal transition {cur} -> {new}",
+                    corrupt=True,
+                ))
+            state[jid] = new
+    return findings
+
+
+def _check_pool_records(records: list, rel_dir: str) -> list:
+    """Pool-ledger unit-key consistency (DESIGN.md §17)."""
+    from ..pool.units import unit_key
+
+    findings: list = []
+    keys: dict = {}  # unit_id -> {key: first-source}
+
+    def note_key(uid: str, key, source: str):
+        if not key:
+            return
+        seen = keys.setdefault(uid, {})
+        if key not in seen:
+            seen[key] = source
+            if len(seen) > 1:
+                srcs = ", ".join(
+                    f"{k[:8]}… from {v}" for k, v in seen.items()
+                )
+                findings.append(Finding(
+                    "ledger-key", rel_dir,
+                    f"unit {uid}: conflicting unit keys in one ledger "
+                    f"({srcs}) — the campaign definition changed under "
+                    "a live ledger", corrupt=True,
+                ))
+
+    for rec in records:
+        t = rec.get("t")
+        if t == "unit":
+            spec = rec.get("unit") or {}
+            uid = str(spec.get("unit_id", "?"))
+            stamped = spec.get("key")
+            recomputed = unit_key(spec)
+            if stamped and stamped != recomputed:
+                findings.append(Finding(
+                    "ledger-key", rel_dir,
+                    f"unit {uid}: spec record does not hash to its own "
+                    f"stamped key (stamped {str(stamped)[:8]}…, content "
+                    f"hashes to {recomputed[:8]}…) — edited spec",
+                    corrupt=True,
+                ))
+            note_key(uid, stamped, "unit spec")
+        elif t in ("lease", "ack", "poison"):
+            note_key(str(rec.get("unit_id", "?")), rec.get("key"), t)
+    return findings
+
+
+# ---- checkpoints + warm cache ------------------------------------------
+
+_CKPT_REQUIRED = {
+    # kind -> members beyond the common {format, cycle_base, steps_run}
+    "warm": ("steps", "trace_sha", "state_counters", "host_counters"),
+    "fleet": ("configs_json", "trace_shas", "state_counters"),
+    "element": ("config_json", "trace_sha", "state_counters"),
+    "stream": ("config_json", "trace_sha", "state_counters"),
+    "solo": ("config_json", "trace_sha", "state_counters"),
+}
+
+
+def _npz_kind(z: dict) -> str:
+    for kind in ("warm", "fleet", "element", "stream"):
+        if kind in z:
+            return kind
+    return "solo"
+
+
+def _check_npz(path: str, rel: str) -> list:
+    from ..sim.checkpoint import (
+        _FORMAT,
+        CheckpointCorrupt,
+        load_verified_npz,
+    )
+    from ..stats.counters import COUNTER_NAMES
+
+    try:
+        z = load_verified_npz(path)
+    except CheckpointCorrupt as e:
+        return [Finding("checkpoint", rel, str(e), corrupt=True,
+                        repairable=True)]
+    findings: list = []
+    got = int(z["format"]) if "format" in z else None
+    if got != _FORMAT:
+        findings.append(Finding(
+            "checkpoint", rel,
+            f"unsupported format {got} (this build reads {_FORMAT})",
+            corrupt=True, repairable=True,
+        ))
+        return findings
+    kind = _npz_kind(z)
+    missing = [
+        m for m in ("cycle_base", "steps_run") + _CKPT_REQUIRED[kind]
+        if m not in z
+    ]
+    if missing:
+        findings.append(Finding(
+            "checkpoint", rel,
+            f"{kind} checkpoint is missing member(s): "
+            f"{', '.join(missing)}", corrupt=True, repairable=True,
+        ))
+        return findings
+    axis = 1 if kind == "fleet" else 0
+    rows = z["state_counters"].shape[axis]
+    if rows != len(COUNTER_NAMES):
+        findings.append(Finding(
+            "checkpoint", rel,
+            f"{kind} checkpoint carries {rows} counter rows but this "
+            f"build defines {len(COUNTER_NAMES)}", corrupt=True,
+            repairable=True,
+        ))
+    if kind == "warm":
+        findings.extend(_check_warm(path, rel, z))
+    return findings
+
+
+def _check_warm(path: str, rel: str, z: dict) -> list:
+    """Sidecar ↔ filename ↔ npz agreement for one warm entry."""
+    findings: list = []
+    stem = os.path.basename(path)[:-len(".npz")]
+    meta_path = path[:-len(".npz")] + ".json"
+    if not os.path.exists(meta_path):
+        findings.append(Finding(
+            "warm-cache", rel,
+            "warm entry has no JSON sidecar — unreachable by "
+            "find_warm_states (interrupted save; safe to quarantine)",
+            corrupt=False, repairable=True,
+        ))
+        return findings
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        findings.append(Finding(
+            "warm-cache", rel, f"unreadable sidecar: {e}", corrupt=True,
+            repairable=True,
+        ))
+        return findings
+    if meta.get("key") != stem:
+        findings.append(Finding(
+            "warm-cache", rel,
+            f"sidecar key {str(meta.get('key'))[:12]}… does not match "
+            f"filename stem {stem[:12]}… (renamed entry)", corrupt=True,
+            repairable=True,
+        ))
+    if int(meta.get("steps", -1)) != int(z["steps"]):
+        findings.append(Finding(
+            "warm-cache", rel,
+            f"sidecar claims {meta.get('steps')} steps but the entry "
+            f"holds {int(z['steps'])}", corrupt=True, repairable=True,
+        ))
+    if str(meta.get("trace_sha")) != bytes(z["trace_sha"]).decode():
+        findings.append(Finding(
+            "warm-cache", rel,
+            "sidecar trace fingerprint disagrees with the entry",
+            corrupt=True, repairable=True,
+        ))
+    return findings
+
+
+# ---- the walk -----------------------------------------------------------
+
+
+def run_fsck(root: str, repair: str = "none") -> FsckResult:
+    """Verify every durable artifact under `root`. `repair` is "none"
+    (default, purely read-only) or "quarantine" (move — never delete —
+    repairable corrupt/orphan FILES into `<root>/.fsck-quarantine/`)."""
+    if repair not in ("none", "quarantine"):
+        raise FsckCorrupt(f"unknown --repair mode {repair!r}")
+    root = os.path.abspath(root)
+    if not os.path.isdir(root):
+        raise FsckCorrupt(f"not a directory: {root}", path=root)
+
+    from ..serve.journal import _SEG_RE
+
+    findings: list = []
+    checked = {"journals": 0, "records": 0, "checkpoints": 0,
+               "warm_entries": 0, "orphans": 0}
+
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != ".fsck-quarantine"]
+        names = set(filenames)
+        is_journal_dir = _JOURNAL_ACTIVE in names or any(
+            _SEG_RE.match(n) for n in names
+        )
+        journal_files = {
+            n for n in names
+            if n == _JOURNAL_ACTIVE or _SEG_RE.match(n)
+        }
+        if is_journal_dir:
+            checked["journals"] += 1
+            rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+            records, jfinds = _check_journal_dir(dirpath, root)
+            findings.extend(jfinds)
+            checked["records"] += len(records)
+            types = {r.get("t") for r in records}
+            if types & _SERVE_TYPES:
+                findings.extend(_check_serve_records(records, rel_dir))
+            if types & _POOL_TYPES:
+                findings.extend(_check_pool_records(records, rel_dir))
+        for name in sorted(names - journal_files):
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if name.endswith(".tmp"):
+                checked["orphans"] += 1
+                findings.append(Finding(
+                    "orphan", rel,
+                    "leftover atomic-write temp file (normal kill -9 "
+                    "debris; safe to quarantine)", corrupt=False,
+                    repairable=True,
+                ))
+            elif name.endswith(".npz"):
+                checked["checkpoints"] += 1
+                nf = _check_npz(path, rel)
+                if any(f.kind == "warm-cache" or "warm" in f.detail
+                       for f in nf) or _is_warm_file(path):
+                    checked["warm_entries"] += 1
+                findings.extend(nf)
+            elif name.endswith(".json") and _looks_like_sidecar(name):
+                if not os.path.exists(path[:-len(".json")] + ".npz"):
+                    checked["orphans"] += 1
+                    findings.append(Finding(
+                        "orphan", rel,
+                        "warm-cache sidecar with no npz entry (the "
+                        "entry was pruned or its save was interrupted)",
+                        corrupt=False, repairable=True,
+                    ))
+
+    quarantined: list = []
+    if repair == "quarantine":
+        qroot = os.path.join(root, ".fsck-quarantine")
+        for f in findings:
+            if not f.repairable or not (f.corrupt or f.kind == "orphan"):
+                continue
+            src = os.path.join(root, f.path)
+            if not os.path.isfile(src):
+                continue
+            dst = os.path.join(qroot, f.path)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.move(src, dst)
+            quarantined.append(f.path)
+
+    findings.sort(key=lambda f: (f.path, f.kind, f.detail))
+    return FsckResult(root=root, findings=findings, checked=checked,
+                      quarantined=quarantined)
+
+
+def _is_warm_file(path: str) -> bool:
+    stem = os.path.basename(path)[:-len(".npz")]
+    return len(stem) == 64 and all(c in "0123456789abcdef" for c in stem)
+
+
+def _looks_like_sidecar(name: str) -> bool:
+    stem = name[:-len(".json")]
+    return len(stem) == 64 and all(c in "0123456789abcdef" for c in stem)
+
+
+# ---- rendering ----------------------------------------------------------
+
+
+def render_human(res: FsckResult) -> str:
+    out = []
+    for f in res.findings:
+        tag = "CORRUPT" if f.corrupt else "note"
+        out.append(f"{tag}: {f.path}: [{f.kind}] {f.detail}")
+    for p in res.quarantined:
+        out.append(f"quarantined: {p} -> .fsck-quarantine/{p}")
+    c = res.checked
+    out.append(
+        f"checked {c['journals']} journal(s) / {c['records']} record(s), "
+        f"{c['checkpoints']} checkpoint(s), {c['warm_entries']} warm "
+        f"entr(ies), {c['orphans']} orphan(s): "
+        f"{len(res.corrupt)} corrupt, "
+        f"{len(res.findings) - len(res.corrupt)} note(s)"
+    )
+    return "\n".join(out)
+
+
+def render_json(res: FsckResult) -> str:
+    return json.dumps(
+        {
+            "root": res.root,
+            "findings": [f.as_dict() for f in res.findings],
+            "quarantined": res.quarantined,
+            "checked": res.checked,
+            "summary": {
+                "corrupt": len(res.corrupt),
+                "notes": len(res.findings) - len(res.corrupt),
+            },
+        },
+        indent=2,
+        sort_keys=True,
+    )

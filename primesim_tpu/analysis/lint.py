@@ -1,0 +1,309 @@
+"""AST lint framework for the repo's load-bearing invariants.
+
+The rules themselves live in `rules.py`; this module is the machinery:
+a registry, per-line suppression comments, a committed baseline for
+grandfathered findings, and human/JSON rendering. The contract (also
+DESIGN.md §19):
+
+  - a rule is a function `check(tree, ctx)` yielding `(lineno, col,
+    message)` tuples, registered with @rule(id, summary, scope=...);
+    `scope` is a tuple of path substrings matched against
+    "/" + repo-relative-posix-path (empty scope = every file)
+  - `# ptlint: allow=PT-XXX` (comma list, or `*`) on the flagged line
+    or the line directly above suppresses a finding at that site
+  - LINT_BASELINE.json grandfathers pre-existing findings: entries
+    match by (rule, path, stripped line text) and each absorbs up to
+    `count` findings; every entry carries a one-line `why`.  Entries
+    that match nothing are reported as stale (the debt was paid —
+    delete the entry)
+  - exit codes: 0 clean, 1 findings, 2 AnalysisError (via the CLI's
+    structured-error contract)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable, Iterator
+
+from .errors import AnalysisError
+
+BASELINE_NAME = "LINT_BASELINE.json"
+
+_ALLOW_RE = re.compile(r"#\s*ptlint:\s*allow=([A-Za-z0-9_\-*,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # repo-relative posix path
+    line: int        # 1-based
+    col: int
+    message: str
+    line_text: str   # stripped source line (the baseline matching key)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    summary: str
+    scope: tuple
+    check: Callable
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, summary: str, scope: tuple = ()):
+    """Register a lint rule. `check(tree, ctx)` yields (lineno, col,
+    message); the framework attaches path/line-text and handles
+    suppression + baseline."""
+
+    def deco(fn):
+        RULES[rule_id] = Rule(rule_id, summary, tuple(scope), fn)
+        return fn
+
+    return deco
+
+
+class FileContext:
+    """What a rule sees about the file under scrutiny."""
+
+    def __init__(self, relpath: str, src: str):
+        self.relpath = relpath
+        self.src = src
+        self.lines = src.splitlines()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def _scope_matches(scope: tuple, relpath: str) -> bool:
+    if not scope:
+        return True
+    probe = "/" + relpath.replace(os.sep, "/")
+    return any(s in probe for s in scope)
+
+
+def _allowed_rules(ctx: FileContext, lineno: int) -> set:
+    """Rule ids suppressed at `lineno` (same line or the line above)."""
+    out: set = set()
+    for ln in (lineno, lineno - 1):
+        text = ctx.line_text(ln)
+        m = _ALLOW_RE.search(text)
+        if m:
+            out |= {t.strip() for t in m.group(1).split(",") if t.strip()}
+    return out
+
+
+def iter_py_files(roots: Iterable[str]) -> Iterator[str]:
+    for root in roots:
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".fsck-quarantine")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def repo_root() -> str:
+    """The directory holding the primesim_tpu package (= repo root)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list          # surviving Findings (fail the run)
+    suppressed: int         # killed by # ptlint: allow=
+    baselined: int          # absorbed by the baseline file
+    stale: list             # baseline entries that matched nothing
+    files: int              # files scanned
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+class _Baseline:
+    """Matches findings against committed entries.
+
+    Each entry {rule, path, line_text, count, why} absorbs up to
+    `count` findings whose (rule, path, stripped line text) agree —
+    line NUMBERS deliberately don't participate, so unrelated edits
+    above a grandfathered site don't invalidate the baseline.
+    """
+
+    def __init__(self, entries: list):
+        self._budget: dict = {}
+        self._entries = entries
+        for i, e in enumerate(entries):
+            for field in ("rule", "path", "line_text", "why"):
+                if not isinstance(e.get(field), str) or not e[field]:
+                    raise AnalysisError(
+                        f"baseline entry {i}: missing/empty '{field}'"
+                    )
+            key = (e["rule"], e["path"], e["line_text"].strip())
+            self._budget[key] = self._budget.get(key, 0) + int(
+                e.get("count", 1)
+            )
+        self._spent: dict = {k: 0 for k in self._budget}
+
+    def absorb(self, f: Finding) -> bool:
+        key = (f.rule, f.path, f.line_text)
+        if self._spent.get(key, 0) < self._budget.get(key, 0):
+            self._spent[key] += 1
+            return True
+        return False
+
+    def stale_entries(self) -> list:
+        return [
+            {"rule": k[0], "path": k[1], "line_text": k[2],
+             "unused": self._budget[k] - self._spent[k]}
+            for k in self._budget
+            if self._spent[k] < self._budget[k]
+        ]
+
+
+def load_baseline(path: str) -> _Baseline:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return _Baseline([])
+    except json.JSONDecodeError as e:
+        raise AnalysisError(
+            f"baseline is not valid JSON: {e}", path=path, line=e.lineno
+        )
+    if not isinstance(doc, dict) or not isinstance(doc.get("entries"), list):
+        raise AnalysisError(
+            "baseline must be {\"entries\": [...]}", path=path
+        )
+    try:
+        return _Baseline(doc["entries"])
+    except AnalysisError as e:
+        raise AnalysisError(str(e), path=path)
+
+
+def run_lint(
+    paths: Iterable[str] | None = None,
+    root: str | None = None,
+    baseline_path: str | None = None,
+    select: Iterable[str] | None = None,
+) -> LintResult:
+    """Lint `paths` (default: the primesim_tpu package under `root`).
+
+    `root` anchors repo-relative paths (default: the repo root derived
+    from this package's location). Raises AnalysisError on unparseable
+    source or a malformed baseline.
+    """
+    # the shipped rules register on import
+    from . import rules as _rules  # noqa: F401
+
+    root = os.path.abspath(root or repo_root())
+    if paths is None:
+        paths = [os.path.join(root, "primesim_tpu")]
+    if baseline_path is None:
+        baseline_path = os.path.join(root, BASELINE_NAME)
+    baseline = load_baseline(baseline_path)
+
+    active = list(RULES.values())
+    if select:
+        select = set(select)
+        unknown = select - set(RULES)
+        if unknown:
+            raise AnalysisError(
+                f"unknown rule id(s): {', '.join(sorted(unknown))}"
+            )
+        active = [r for r in active if r.rule_id in select]
+
+    findings: list = []
+    suppressed = 0
+    baselined = 0
+    n_files = 0
+    for fpath in iter_py_files(paths):
+        relpath = os.path.relpath(fpath, root).replace(os.sep, "/")
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            raise AnalysisError(f"cannot read source: {e}", path=relpath)
+        scoped = [r for r in active if _scope_matches(r.scope, relpath)]
+        if not scoped:
+            continue
+        n_files += 1
+        try:
+            tree = ast.parse(src, filename=relpath)
+        except SyntaxError as e:
+            raise AnalysisError(
+                f"syntax error: {e.msg}", path=relpath, line=e.lineno
+            )
+        ctx = FileContext(relpath, src)
+        for r in scoped:
+            for lineno, col, message in r.check(tree, ctx):
+                f_obj = Finding(
+                    rule=r.rule_id, path=relpath, line=lineno, col=col,
+                    message=message, line_text=ctx.line_text(lineno),
+                )
+                if r.rule_id in _allowed_rules(ctx, lineno) or (
+                    "*" in _allowed_rules(ctx, lineno)
+                ):
+                    suppressed += 1
+                elif baseline.absorb(f_obj):
+                    baselined += 1
+                else:
+                    findings.append(f_obj)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(
+        findings=findings, suppressed=suppressed, baselined=baselined,
+        stale=baseline.stale_entries(), files=n_files,
+    )
+
+
+def render_human(res: LintResult) -> str:
+    out = []
+    for f in res.findings:
+        out.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
+        out.append(f"    {f.line_text}")
+    for s in res.stale:
+        out.append(
+            f"stale baseline entry ({s['unused']} unused): "
+            f"{s['rule']} {s['path']}: {s['line_text']}"
+        )
+    out.append(
+        f"{len(res.findings)} finding(s) in {res.files} file(s) "
+        f"({res.baselined} baselined, {res.suppressed} suppressed, "
+        f"{len(res.stale)} stale baseline entries)"
+    )
+    return "\n".join(out)
+
+
+def render_json(res: LintResult) -> str:
+    return json.dumps(
+        {
+            "findings": [f.as_dict() for f in res.findings],
+            "stale_baseline": res.stale,
+            "summary": {
+                "findings": len(res.findings),
+                "files": res.files,
+                "baselined": res.baselined,
+                "suppressed": res.suppressed,
+            },
+        },
+        indent=2,
+        sort_keys=True,
+    )

@@ -1003,6 +1003,41 @@ def cmd_info(ns) -> int:
     return 0
 
 
+def cmd_lint(ns) -> int:
+    from ..analysis.lint import render_human, render_json, run_lint
+
+    res = run_lint(
+        paths=ns.paths or None,
+        root=ns.root,
+        baseline_path=ns.baseline,
+        select=ns.select or None,
+    )
+    if ns.format == "json":
+        print(render_json(res))
+    else:
+        print(render_human(res))
+    return 0 if res.clean else 1
+
+
+def cmd_fsck(ns) -> int:
+    from ..analysis.errors import FsckCorrupt
+    from ..analysis.fsck import render_human, render_json, run_fsck
+
+    res = run_fsck(ns.dir, repair=ns.repair)
+    if ns.format == "json":
+        print(render_json(res))
+    else:
+        print(render_human(res))
+    if not res.clean:
+        first = res.corrupt[0]
+        raise FsckCorrupt(
+            f"{len(res.corrupt)} corrupt artifact finding(s) under "
+            f"{ns.dir} (first: {first.path}: {first.detail})",
+            path=first.path, n_corrupt=len(res.corrupt),
+        )
+    return 0
+
+
 def _parse_buckets(spec: str):
     """'SLOTSxPAGES[,SLOTSxPAGES...]' -> ((slots, pages), ...) — the
     serving fleet's paged capacity ladder (serve.scheduler)."""
@@ -1697,18 +1732,66 @@ def build_parser() -> argparse.ArgumentParser:
         help="--watch: stop after N lines (default 0 = forever)",
     )
     t.set_defaults(fn=cmd_serve_status)
+
+    li = sub.add_parser(
+        "lint",
+        help="check the source tree against the invariant catalog "
+             "(DESIGN.md §19); exit 0 clean, 1 findings, 2 on analysis "
+             "failure",
+    )
+    li.add_argument(
+        "paths", nargs="*", metavar="PATH",
+        help="files/dirs to lint (default: the primesim_tpu package)",
+    )
+    li.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="repo root anchoring relative paths and the baseline "
+             "(default: auto-detected from the installed package)",
+    )
+    li.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline file of grandfathered findings "
+             "(default: <root>/LINT_BASELINE.json)",
+    )
+    li.add_argument(
+        "--select", action="append", metavar="RULE",
+        help="run only this rule id (repeatable)",
+    )
+    li.add_argument(
+        "--format", choices=("human", "json"), default="human",
+    )
+    li.set_defaults(fn=cmd_lint)
+
+    fk = sub.add_parser(
+        "fsck",
+        help="statically verify durable artifacts (journals, ledgers, "
+             "checkpoints, warm cache) under a directory; exit 2 with "
+             "structured JSON on corruption",
+    )
+    fk.add_argument("dir", metavar="DIR", help="artifact root to verify")
+    fk.add_argument(
+        "--repair", choices=("none", "quarantine"), default="none",
+        help="quarantine moves (never deletes) corrupt/orphaned files "
+             "into DIR/.fsck-quarantine/",
+    )
+    fk.add_argument(
+        "--format", choices=("human", "json"), default="human",
+    )
+    fk.set_defaults(fn=cmd_fsck)
     return p
 
 
 def main(argv=None) -> int:
     ns = build_parser().parse_args(argv)
+    from ..analysis.errors import AnalysisError, FsckCorrupt
     from ..config.machine import FaultConfigError
     from ..sim.checkpoint import CheckpointCorrupt
     from ..trace.format import TraceError
 
     try:
         return ns.fn(ns)
-    except (TraceError, FaultConfigError, CheckpointCorrupt, VarySpecError) as e:
+    except (TraceError, FaultConfigError, CheckpointCorrupt, VarySpecError,
+            AnalysisError, FsckCorrupt) as e:
         # typed errors exit 2 with ONE structured JSON line on stderr —
         # {"error": {type, location, detail}} — the same shape the serve
         # protocol and sweep quarantine lines use, so scripts parse one

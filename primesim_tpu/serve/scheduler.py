@@ -61,35 +61,64 @@ class QueueFull(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class WorkloadSpecError(ValueError):
+    """A job's workload SPEC (synth grammar / trace-vs-synth choice) is
+    invalid. Subclasses ValueError so every existing quarantine path
+    (`except ValueError` at the scheduler/server boundary) still
+    catches it, but carries a `.location()` so the CLI and protocol can
+    emit the structured {type, location, detail} error shape."""
+
+    def __init__(self, msg: str, *, spec: str | None = None,
+                 field: str | None = None):
+        super().__init__(msg)
+        self.spec = spec
+        self.field = field
+
+    def location(self) -> dict:
+        loc: dict = {}
+        if self.spec is not None:
+            loc["spec"] = self.spec
+        if self.field is not None:
+            loc["field"] = self.field
+        return loc
+
+
 def parse_synth_spec(spec: str, n_cores: int, fold: bool):
     """`name:k=v,...` -> Trace (the CLI's --synth grammar, but raising
-    ValueError instead of SystemExit so a bad spec quarantines the job
-    with a structured error rather than killing the daemon)."""
+    WorkloadSpecError (a ValueError) instead of SystemExit so a bad
+    spec quarantines the job with a structured error rather than
+    killing the daemon)."""
     from ..trace import synth
     from ..trace.format import fold_ins
 
     name, _, args = spec.partition(":")
     if name not in synth.GENERATORS:
-        raise ValueError(
+        raise WorkloadSpecError(
             f"unknown generator {name!r}; have: "
-            f"{', '.join(sorted(synth.GENERATORS))}"
+            f"{', '.join(sorted(synth.GENERATORS))}", spec=spec,
         )
     kw = {}
     if args:
         for pair in args.split(","):
             k, eq, v = pair.partition("=")
             if not eq or not k:
-                raise ValueError(f"bad synth arg {pair!r} (want key=value)")
+                raise WorkloadSpecError(
+                    f"bad synth arg {pair!r} (want key=value)",
+                    spec=spec, field=k or pair,
+                )
             try:
                 kw[k] = int(v)
             except ValueError:
-                raise ValueError(
-                    f"bad synth arg {pair!r}: value must be an integer"
+                raise WorkloadSpecError(
+                    f"bad synth arg {pair!r}: value must be an integer",
+                    spec=spec, field=k,
                 ) from None
     try:
         tr = synth.GENERATORS[name](n_cores, **kw)
     except TypeError as e:
-        raise ValueError(f"synth {name!r}: {e}") from None
+        raise WorkloadSpecError(
+            f"synth {name!r}: {e}", spec=spec
+        ) from None
     return fold_ins(tr) if fold else tr
 
 
@@ -102,7 +131,10 @@ def materialize_workload(job: J.Job, cfg):
     from ..trace.format import Trace, fold_ins
 
     if (job.trace_path is None) == (job.synth is None):
-        raise ValueError("job needs exactly one of trace_path | synth")
+        raise WorkloadSpecError(
+            "job needs exactly one of trace_path | synth",
+            field="trace_path|synth",
+        )
     if job.trace_path is not None:
         tr = Trace.load(job.trace_path)
         if job.fold:

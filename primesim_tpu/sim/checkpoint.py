@@ -636,12 +636,24 @@ def save_warm_state(root: str, cfg, trace_fp: str, steps: int, snap: dict) -> st
         "trace_sha": str(trace_fp),
         "steps": int(steps),
     }
-    tmp = f"{meta_path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, meta_path)
+    # writer-unique temp name, same discipline as atomic_save_npz:
+    # concurrent sweeps warming the same entry must not rename each
+    # other's sidecar away mid-write
+    fd, tmp = tempfile.mkstemp(
+        dir=root, prefix=os.path.basename(meta_path) + ".", suffix=".tmp",
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, meta_path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
     prune_warm_cache(root)
     return key
 

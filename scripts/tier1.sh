@@ -7,4 +7,10 @@ rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# invariant lint gate (DESIGN.md §19): the tree + committed baseline must
+# have zero findings. Pytest's status stays authoritative — the lint
+# result is only surfaced when the suite itself passed.
+if [ "$rc" -eq 0 ]; then
+  env JAX_PLATFORMS=cpu python -m primesim_tpu lint || rc=$?
+fi
 exit $rc

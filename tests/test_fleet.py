@@ -13,6 +13,7 @@ geometry, with every timing knob traced.
 import numpy as np
 import pytest
 
+from primesim_tpu.analysis.recompile import recompile_sentinel
 from primesim_tpu.config.machine import small_test_config
 from primesim_tpu.sim.engine import Engine
 from primesim_tpu.sim.fleet import (
@@ -70,8 +71,12 @@ def test_fleet_parity_mixed_traces_and_knobs():
         {"quantum": 150, "cpi": 2},
         {"link_lat": 3, "router_lat": 2, "cpi": [1, 2, 1, 2, 3, 1, 1, 2]},
     ]
-    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
-    fleet.run()
+    # the whole 4-element knob sweep must be ONE compilation of the
+    # fleet program (jit key = timing-normalized geometry)
+    with recompile_sentinel(allowed=1, watch=("fleet",),
+                            label="mixed traces+knobs sweep"):
+        fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
+        fleet.run()
     assert fleet.done() and list(fleet.done_mask()) == [True] * 4
     for i, (t, ov) in enumerate(zip(traces, overrides)):
         assert_element_matches_solo(
@@ -105,8 +110,10 @@ def test_fleet_parity_contention_and_dram_queue_knobs():
         {"contention_lat": 7, "dram_service": 35},
         {"dram_service": 0, "dram_lat": 90, "contention_lat": 2},
     ]
-    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
-    fleet.run()
+    with recompile_sentinel(allowed=1, watch=("fleet",),
+                            label="contention/dram knob sweep"):
+        fleet = FleetEngine(cfg, traces, overrides, chunk_steps=32)
+        fleet.run()
     for i, (t, ov) in enumerate(zip(traces, overrides)):
         assert_element_matches_solo(
             fleet, i, apply_overrides(cfg, ov), t, chunk_steps=32
@@ -131,8 +138,10 @@ def test_fleet_parity_router_model():
         synth.false_sharing(8, n_mem_ops=40, seed=33),
     ]
     overrides = [{}, {"link_lat": 4, "quantum": 250}, {"router_lat": 5}]
-    fleet = FleetEngine(cfg, traces, overrides, chunk_steps=16)
-    fleet.run()
+    with recompile_sentinel(allowed=1, watch=("fleet",),
+                            label="router-model knob sweep"):
+        fleet = FleetEngine(cfg, traces, overrides, chunk_steps=16)
+        fleet.run()
     for i, (t, ov) in enumerate(zip(traces, overrides)):
         assert_element_matches_solo(
             fleet, i, apply_overrides(cfg, ov), t, chunk_steps=16
