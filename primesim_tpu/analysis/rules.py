@@ -255,6 +255,53 @@ def check_durable(tree, ctx):
 
 
 @rule(
+    "PT-CHAOS-SITE",
+    "durable writes and socket sends stay behind chaos fault sites",
+    scope=("/serve/", "/pool/", "checkpoint.py"),
+)
+def check_chaos_site(tree, ctx):
+    """A function that fsyncs or sendalls on the serve/pool paths must
+    also call a registered chaos hook (`chaos.durable`, `chaos.
+    socket_send`, `chaos.crashpoint`, ...) so the fault-injection
+    coverage of DESIGN.md §20 cannot silently rot as I/O paths are
+    added. Maintenance-only paths (tail repair, dir fsync) baseline
+    with a `why`."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        risky = []   # (lineno, col, what)
+        covered = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if (
+                f.attr == "fsync"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "os"
+            ):
+                risky.append((node.lineno, node.col_offset, "os.fsync"))
+            elif f.attr == "sendall":
+                risky.append((node.lineno, node.col_offset, "sendall"))
+            elif (
+                isinstance(f.value, ast.Name) and f.value.id == "chaos"
+            ):
+                covered = True
+        if covered:
+            continue
+        for lineno, col, what in risky:
+            yield (
+                lineno, col,
+                f"{what} in {fn.name}() without a chaos fault site — "
+                "thread chaos.durable/chaos.socket_send/chaos."
+                "crashpoint through this path (chaos/sites.py) or "
+                "baseline it with a why",
+            )
+
+
+@rule(
     "PT-TYPED-ERR",
     "no bare ValueError/RuntimeError on CLI-reachable paths",
     scope=("/cli/", "/serve/", "/pool/"),

@@ -1,0 +1,42 @@
+"""Deterministic chaos for the simulator's OWN infrastructure
+(DESIGN.md §20).
+
+`primesim_tpu/faults/` injects faults into the simulated machine; this
+package injects faults into the machinery that RUNS the simulation —
+journals, checkpoints, sockets, process lifetimes, clocks — and then
+machine-checks that the durability invariants survived:
+
+- `plan`     — `FaultPlan`: a seeded, JSON-serializable schedule of
+               fault events keyed by site name + occurrence index, so
+               any failing trial is a one-line repro.
+- `sites`    — the fault-site registry threaded through the real I/O
+               paths (journal append, checkpoint replace, protocol
+               send/recv, named crashpoints, lease clocks). With no
+               plan installed every hook is a no-op and the serve/pool
+               stack stays bit-exact.
+- `campaign` — seeded trial runner + invariant checks + plan shrinker
+               behind the `primetpu chaos` CLI verb.
+"""
+
+from .plan import FaultEvent, FaultPlan
+from .sites import (
+    SITES,
+    ChaosCrash,
+    active,
+    crashpoint,
+    deactivate,
+    install,
+    install_from_env,
+)
+
+__all__ = [
+    "FaultEvent",
+    "FaultPlan",
+    "SITES",
+    "ChaosCrash",
+    "active",
+    "crashpoint",
+    "deactivate",
+    "install",
+    "install_from_env",
+]

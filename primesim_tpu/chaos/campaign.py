@@ -1,0 +1,500 @@
+"""Invariant-checked crash campaigns over the serve/pool stack.
+
+A TRIAL runs a real serving workload under one seeded `FaultPlan` and
+then machine-checks the durability story the stack promises:
+
+  A. NO ACKED JOB LOST — every submit whose ACK was observed is present
+     (same job_id, exactly once) after every crash/restart, and reaches
+     a terminal state.
+  B. BIT-EXACT RESULTS — surviving state replays to the same results a
+     fault-free GOLDEN run of the identical workload produces
+     (deterministic fields only; wall-clock throughput is stripped).
+  C. FSCK CLEAN — `primetpu fsck` over the surviving state directory
+     finds nothing corrupt (a torn tail in the newest journal segment is
+     legal by the WAL contract and repaired on open, so it never shows).
+  D. NO DOUBLE-ENQUEUE — a retried submit after a lost ACK (idempotency
+     token) must not create a twin job.
+
+The serve trial is IN-PROCESS: it rebuilds the scheduler over the same
+state dir after every injected crash, exactly replicating the server's
+`_recover()` (journal replay -> fold -> adopt/requeue). Injected process
+death arrives as `ChaosCrash` (BaseException) and the harness plays the
+role of init: catch, count the restart, boot again. One ChaosRuntime
+spans the whole trial, so fired events never re-fire and a plan with K
+crash events bounds the trial at K restarts.
+
+The socket trial runs a REAL PrimeServer in a thread and drives it with
+a `ServeClient` whose reconnect/idempotency machinery is the system
+under test; its plans draw only from the client-side socket sites.
+
+On violation, `run_campaign` shrinks the plan (greedy ddmin re-running
+the trial) to a 1-minimal event set and writes a repro artifact: the
+seed, the shrunk plan JSON, and the violation text — `primetpu chaos
+--plan <artifact>` replays it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+
+from . import plan as P
+from . import sites
+
+#: Sites the in-process serve trial actually reaches, by fault class.
+SERVE_SITES = {
+    "durable": ("journal.append", "checkpoint.write"),
+    "crashpoint": (
+        "server.post-journal-pre-ack",
+        "scheduler.pre-dispatch",
+        "scheduler.post-dispatch",
+        "scheduler.post-checkpoint",
+    ),
+    "socket": ("protocol.send", "protocol.recv"),
+}
+
+#: Small deterministic workloads (serve's synth grammar). Distinct seeds
+#: give distinct results, so a cross-wired job table fails invariant B.
+DEFAULT_SPECS = (
+    "fft_like:n_phases=1,points_per_core=8,ins_per_mem=4,seed=101",
+    "fft_like:n_phases=1,points_per_core=8,ins_per_mem=4,seed=102",
+    "fft_like:n_phases=1,points_per_core=8,ins_per_mem=4,seed=103",
+)
+
+_MAX_TICKS = 20_000  # convergence guard for one boot's tick loop
+
+# result fields that depend on wall time, not on the simulation
+_NONDET_KEYS = ("wall_s", "value", "latency_s", "accepted_t")
+
+
+@dataclasses.dataclass
+class TrialResult:
+    plan: P.FaultPlan
+    violations: list
+    injected: list        # events that actually fired, in order
+    restarts: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "seed": self.plan.seed,
+            "plan": self.plan.as_dict(),
+            "violations": list(self.violations),
+            "injected": list(self.injected),
+            "restarts": self.restarts,
+        }
+
+
+def _canon(result) -> str:
+    """Canonical form of a job result for bit-exact comparison: drop
+    wall-clock-dependent fields, keep every simulation-determined one."""
+
+    def strip(obj):
+        if isinstance(obj, dict):
+            return {k: strip(v) for k, v in sorted(obj.items())
+                    if k not in _NONDET_KEYS}
+        if isinstance(obj, list):
+            return [strip(v) for v in obj]
+        return obj
+
+    return json.dumps(strip(result), sort_keys=True)
+
+
+def _default_cfg():
+    from ..config.machine import small_test_config
+
+    return small_test_config(4)
+
+
+# ---- the in-process serve trial ------------------------------------------
+
+
+def _boot(state_dir: str, cfg, buckets, chunk_steps: int):
+    """One server lifetime's worth of scheduler, recovered from whatever
+    the previous lifetime left on disk — the exact `server._recover()`
+    sequence, minus the listener."""
+    from ..serve.journal import JobJournal, fold_records, serve_compactor
+    from ..serve.scheduler import Scheduler
+
+    journal = JobJournal(state_dir, compactor=serve_compactor)
+    sched = Scheduler(
+        cfg, journal, state_dir, buckets=buckets, chunk_steps=chunk_steps,
+        checkpoint_every_s=0.0,  # checkpoint every tick: deterministic,
+        #                          and it exercises checkpoint.write hard
+    )
+    records, _dropped = journal.replay()
+    jobs, _clean = fold_records(records)
+    for job in jobs.values():
+        if job.terminal:
+            sched.adopt_terminal(job)
+        else:
+            sched.requeue_recovered(job)
+    if jobs:
+        sched._seq = max(
+            (int(j.job_id[1:]) for j in jobs.values()
+             if j.job_id.startswith("j") and j.job_id[1:].isdigit()),
+            default=0,
+        )
+    return sched
+
+
+def _submit_missing(sched, specs, idems, acked, violations) -> None:
+    """Replicate the client's retried-submit path: anything not yet
+    ACKed is (re)submitted under its idempotency token; a token already
+    in the job table means the previous attempt's accept record survived
+    a lost ACK and the job is adopted instead of double-enqueued."""
+    from ..serve import jobs as J
+
+    for i in range(len(specs)):
+        jid = acked.get(i)
+        if jid is not None:
+            if jid not in sched.jobs:
+                violations.append(
+                    f"invariant A: ACKed job {jid} (spec {i}) lost after "
+                    "restart"
+                )
+            continue
+        dup = next(
+            (j for j in sched.jobs.values() if j.idem == idems[i]), None
+        )
+        if dup is not None:
+            acked[i] = dup.job_id  # lost-ACK retry answered by dedup
+            continue
+        job = J.Job(job_id=sched.next_job_id(), idem=idems[i],
+                    client="chaos", synth=specs[i])
+        sched.submit(job)  # may ChaosCrash post-journal-pre-ack: no ACK
+        acked[i] = job.job_id  # returned = ACK observed
+
+
+def _check_no_twins(sched, idems, violations) -> None:
+    per_tok = {}
+    for j in sched.jobs.values():
+        if j.idem:
+            per_tok[j.idem] = per_tok.get(j.idem, 0) + 1
+    for tok, n in sorted(per_tok.items()):
+        if tok in set(idems.values()) and n > 1:
+            violations.append(
+                f"invariant D: idempotency token {tok} enqueued {n} jobs"
+            )
+
+
+def _run_to_completion(state_dir, cfg, specs, idems, acked, violations,
+                       buckets, chunk_steps) -> dict:
+    """One boot: recover, check invariant A, (re)submit what is missing,
+    tick until every ACKed job is terminal. Raises ChaosCrash whenever
+    the plan kills this 'process'; the caller restarts us."""
+    sched = _boot(state_dir, cfg, buckets, chunk_steps)
+    _submit_missing(sched, specs, idems, acked, violations)
+    _check_no_twins(sched, idems, violations)
+    for _ in range(_MAX_TICKS):
+        if all(sched.jobs[j].terminal for j in acked.values()
+               if j in sched.jobs):
+            break
+        sched.tick()
+    else:
+        violations.append(
+            f"trial did not converge within {_MAX_TICKS} ticks"
+        )
+    out = {}
+    for i, jid in acked.items():
+        job = sched.jobs.get(jid)
+        if job is None:
+            continue  # invariant A already recorded the loss
+        out[i] = {"state": job.state, "result": job.result}
+    sched.journal.close()
+    return out
+
+
+def run_serve_trial(
+    plan: P.FaultPlan,
+    cfg=None,
+    specs=DEFAULT_SPECS,
+    golden: dict | None = None,
+    workdir: str | None = None,
+    keep_dir: bool = False,
+    buckets=((2, 1),),
+    chunk_steps: int = 16,
+) -> TrialResult:
+    """One seeded trial of the in-process serve stack (see module doc).
+    `golden` is the fault-free reference from `golden_run` (computed
+    here when omitted — pass it when running many trials)."""
+    from ..analysis.fsck import run_fsck
+
+    cfg = cfg or _default_cfg()
+    if golden is None:
+        golden = golden_run(cfg, specs, buckets=buckets,
+                            chunk_steps=chunk_steps, workdir=workdir)
+    tmp = tempfile.mkdtemp(prefix="chaos-trial-", dir=workdir)
+    violations: list = []
+    acked: dict = {}
+    idems = {i: f"chaos-{plan.seed}-{i}" for i in range(len(specs))}
+    restarts = 0
+    results: dict = {}
+    rt = sites.install(plan, mode="raise")
+    try:
+        while True:
+            try:
+                results = _run_to_completion(
+                    tmp, cfg, specs, idems, acked, violations,
+                    buckets, chunk_steps,
+                )
+                break
+            except sites.ChaosCrash:
+                restarts += 1
+                if restarts > len(plan.events) + 2:
+                    # cannot happen while events fire at most once; a
+                    # busted runtime must not hang the campaign
+                    violations.append(
+                        f"restart loop: {restarts} restarts for "
+                        f"{len(plan.events)} planned events"
+                    )
+                    break
+        injected = list(rt.injected)
+    finally:
+        sites.deactivate()
+
+    rep = run_fsck(tmp)
+    for f in rep.corrupt:
+        violations.append(
+            f"invariant C: fsck {f.kind} at {f.path}: {f.detail}"
+        )
+    for i in sorted(golden):
+        got = results.get(i)
+        if got is None:
+            if f"invariant A" not in " ".join(violations):
+                violations.append(
+                    f"invariant A: spec {i} never reached a terminal "
+                    "state"
+                )
+            continue
+        if _canon(got) != _canon(golden[i]):
+            violations.append(
+                f"invariant B: spec {i} result diverged from golden "
+                f"(got {_canon(got)[:200]}... want "
+                f"{_canon(golden[i])[:200]}...)"
+            )
+    if not keep_dir:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return TrialResult(plan=plan, violations=violations,
+                       injected=injected, restarts=restarts)
+
+
+def golden_run(cfg=None, specs=DEFAULT_SPECS, buckets=((2, 1),),
+               chunk_steps: int = 16, workdir: str | None = None) -> dict:
+    """The fault-free reference: run the identical workload with no plan
+    installed and keep each job's terminal state + result."""
+    cfg = cfg or _default_cfg()
+    tmp = tempfile.mkdtemp(prefix="chaos-golden-", dir=workdir)
+    violations: list = []
+    acked: dict = {}
+    idems = {i: f"golden-{i}" for i in range(len(specs))}
+    assert sites.runtime() is None, "golden run must be fault-free"
+    try:
+        out = _run_to_completion(tmp, cfg, specs, idems, acked,
+                                 violations, buckets, chunk_steps)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if violations or set(out) != set(range(len(specs))):
+        raise RuntimeError(f"golden run unhealthy: {violations or out}")
+    for i, rec in out.items():
+        if rec["state"] != "DONE":
+            raise RuntimeError(
+                f"golden run: spec {i} ended {rec['state']}, want DONE"
+            )
+    return out
+
+
+# ---- the socket trial (real server + resilient client) -------------------
+
+
+def run_socket_trial(
+    plan: P.FaultPlan,
+    cfg=None,
+    specs=DEFAULT_SPECS,
+    golden: dict | None = None,
+    workdir: str | None = None,
+    buckets=((2, 1),),
+    chunk_steps: int = 16,
+) -> TrialResult:
+    """One seeded trial of the wire path: a real PrimeServer thread, a
+    ServeClient whose reconnect + idempotency machinery is under test,
+    and a plan drawn from the client-side socket sites only (short send,
+    mid-frame disconnect, lost reply, duplicate delivery, delay)."""
+    import threading
+    import time as _time
+
+    from ..analysis.fsck import run_fsck
+    from ..serve.client import ServeClient
+    from ..serve.server import PrimeServer
+
+    for ev in plan.events:
+        if sites.SITES.get(ev.site) != "socket":
+            raise ValueError(
+                f"socket trial plans must be socket-class only, got "
+                f"{ev.site}"
+            )
+    cfg = cfg or _default_cfg()
+    if golden is None:
+        golden = golden_run(cfg, specs, buckets=buckets,
+                            chunk_steps=chunk_steps, workdir=workdir)
+    tmp = tempfile.mkdtemp(prefix="chaos-sock-", dir=workdir)
+    violations: list = []
+    server = PrimeServer(cfg, state_dir=tmp, buckets=buckets,
+                         chunk_steps=chunk_steps, checkpoint_every_s=60.0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    deadline = _time.time() + 60
+    while not os.path.exists(server.socket_path):
+        if _time.time() > deadline:
+            raise RuntimeError("server socket never appeared")
+        _time.sleep(0.01)
+
+    rt = sites.install(plan, mode="raise")
+    try:
+        cli = ServeClient(server.socket_path, timeout_s=60.0,
+                          max_reconnects=2 * len(plan.events) + 2)
+        results: dict = {}
+        for i, spec in enumerate(specs):
+            job = cli.submit(synth=spec, client="chaos",
+                             idem=f"chaos-{plan.seed}-{i}")
+            done = cli.wait(job["job_id"], timeout_s=120.0)
+            results[i] = {"state": done["state"],
+                          "result": done.get("result")}
+        listed = cli.status()
+        injected = list(rt.injected)
+    finally:
+        sites.deactivate()
+    try:
+        ServeClient(server.socket_path, timeout_s=30.0).drain()
+        t.join(timeout=60)
+    except Exception:
+        pass
+
+    if len(listed) != len(specs):
+        violations.append(
+            f"invariant D: {len(listed)} jobs in table for "
+            f"{len(specs)} submits (duplicate enqueue or loss)"
+        )
+    for i in sorted(golden):
+        got = results.get(i)
+        if got is None or _canon(got) != _canon(golden[i]):
+            violations.append(
+                f"invariant B: spec {i} diverged over the wire"
+            )
+    rep = run_fsck(tmp)
+    for f in rep.corrupt:
+        violations.append(
+            f"invariant C: fsck {f.kind} at {f.path}: {f.detail}"
+        )
+    shutil.rmtree(tmp, ignore_errors=True)
+    return TrialResult(plan=plan, violations=violations,
+                       injected=injected)
+
+
+# ---- the campaign --------------------------------------------------------
+
+
+def _trial_sites(classes) -> tuple[list, set]:
+    """(site names plans may use, classes routed to the socket trial)."""
+    names: list = []
+    socket_only = set()
+    for cls in classes:
+        for s in SERVE_SITES.get(cls, ()):
+            names.append(s)
+        if cls == "socket":
+            socket_only.add(cls)
+    return names, socket_only
+
+
+def run_trial(plan, cfg=None, specs=DEFAULT_SPECS, golden=None,
+              workdir=None, **kw) -> TrialResult:
+    """Dispatch one plan to the harness that can reach its sites: plans
+    touching only socket sites go over the wire, everything else runs
+    the in-process serve trial (mixed plans run in-process, where the
+    socket sites are simply never reached and those events stay inert)."""
+    if plan.events and all(
+        sites.SITES.get(e.site) == "socket" for e in plan.events
+    ):
+        return run_socket_trial(plan, cfg=cfg, specs=specs,
+                                golden=golden, workdir=workdir, **kw)
+    return run_serve_trial(plan, cfg=cfg, specs=specs, golden=golden,
+                           workdir=workdir, **kw)
+
+
+def run_campaign(
+    n_trials: int = 20,
+    seed0: int = 0,
+    classes: tuple = ("durable", "crashpoint"),
+    cfg=None,
+    specs=DEFAULT_SPECS,
+    workdir: str | None = None,
+    artifact_dir: str | None = None,
+    max_events: int = 3,
+    progress=None,
+) -> dict:
+    """N seeded trials; on violation, bisect-shrink the plan to a
+    1-minimal event set and write a replayable repro artifact. Returns
+    the campaign report (the `primetpu chaos` JSON surface)."""
+    cfg = cfg or _default_cfg()
+    golden = golden_run(cfg, specs, workdir=workdir)
+    site_pool, _ = _trial_sites(classes)
+    report = {
+        "trials": 0, "violations": [], "fired_events": 0,
+        "classes": list(classes), "seed0": seed0,
+    }
+    for k in range(n_trials):
+        seed = seed0 + k
+        plan = P.generate(seed, classes=classes, sites=site_pool,
+                          max_events=max_events)
+        res = run_trial(plan, cfg=cfg, specs=specs, golden=golden,
+                        workdir=workdir)
+        report["trials"] += 1
+        report["fired_events"] += len(res.injected)
+        if progress is not None:
+            progress(seed, res)
+        if res.ok:
+            continue
+
+        def still_fails(cand) -> bool:
+            return not run_trial(cand, cfg=cfg, specs=specs,
+                                 golden=golden, workdir=workdir).ok
+
+        shrunk = P.shrink(plan, still_fails)
+        final = run_trial(shrunk, cfg=cfg, specs=specs, golden=golden,
+                          workdir=workdir)
+        artifact = {
+            "seed": seed,
+            "plan": shrunk.as_dict(),
+            "original_events": len(plan.events),
+            "shrunk_events": len(shrunk.events),
+            "violations": list(final.violations or res.violations),
+            "injected": list(final.injected),
+            "repro": "primetpu chaos --plan <this file>",
+        }
+        path = None
+        if artifact_dir:
+            os.makedirs(artifact_dir, exist_ok=True)
+            path = os.path.join(artifact_dir, f"chaos-repro-{seed}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+        artifact["artifact_path"] = path
+        report["violations"].append(artifact)
+    report["ok"] = not report["violations"]
+    return report
+
+
+def replay_artifact(path: str, cfg=None, specs=DEFAULT_SPECS,
+                    workdir=None) -> TrialResult:
+    """Re-run the exact plan a repro artifact (or bare plan JSON)
+    carries — the one-line repro loop."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    plan = P.FaultPlan.from_dict(doc.get("plan", doc))
+    return run_trial(plan, cfg=cfg, specs=specs, workdir=workdir)

@@ -1038,6 +1038,41 @@ def cmd_fsck(ns) -> int:
     return 0
 
 
+def cmd_chaos(ns) -> int:
+    """Seeded crash campaign (DESIGN.md §20): N trials of the serve
+    stack under generated fault plans, invariants machine-checked after
+    each; violations shrink to a minimal replayable artifact. Exit 0
+    clean, 3 on any violation."""
+    from ..chaos import campaign as C
+
+    cfg = _load_config(ns.config) if ns.config else None
+    if ns.plan:
+        res = C.replay_artifact(ns.plan, cfg=cfg)
+        print(json.dumps(res.as_dict(), indent=2, sort_keys=True))
+        return 0 if res.ok else 3
+
+    def progress(seed, res):
+        if ns.verbose:
+            print(
+                f"trial seed={seed} "
+                f"{'ok' if res.ok else 'VIOLATION'} "
+                f"fired={len(res.injected)} restarts={res.restarts}",
+                file=sys.stderr,
+            )
+
+    report = C.run_campaign(
+        n_trials=ns.trials,
+        seed0=ns.seed,
+        classes=tuple(ns.classes.split(",")),
+        cfg=cfg,
+        artifact_dir=ns.out,
+        max_events=ns.max_events,
+        progress=progress,
+    )
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0 if report["ok"] else 3
+
+
 def _parse_buckets(spec: str):
     """'SLOTSxPAGES[,SLOTSxPAGES...]' -> ((slots, pages), ...) — the
     serving fleet's paged capacity ladder (serve.scheduler)."""
@@ -1778,10 +1813,57 @@ def build_parser() -> argparse.ArgumentParser:
         "--format", choices=("human", "json"), default="human",
     )
     fk.set_defaults(fn=cmd_fsck)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="seeded crash campaign over the serve stack: generate "
+             "fault plans, inject, machine-check durability invariants, "
+             "shrink violations to a minimal repro artifact (DESIGN.md "
+             "§20); exit 3 on violation",
+    )
+    ch.add_argument(
+        "--trials", type=int, default=20,
+        help="number of seeded trials (default 20)",
+    )
+    ch.add_argument(
+        "--seed", type=int, default=0,
+        help="first trial seed; trial k uses seed+k (default 0)",
+    )
+    ch.add_argument(
+        "--classes", default="durable,crashpoint",
+        help="comma list of fault classes to draw from: durable, "
+             "crashpoint, socket (default durable,crashpoint)",
+    )
+    ch.add_argument(
+        "--max-events", type=int, default=3,
+        help="max fault events per generated plan (default 3)",
+    )
+    ch.add_argument(
+        "--out", default=None, metavar="DIR",
+        help="write chaos-repro-<seed>.json artifacts here on violation",
+    )
+    ch.add_argument(
+        "--plan", default=None, metavar="FILE",
+        help="replay one plan/artifact JSON instead of generating "
+             "(the repro loop)",
+    )
+    ch.add_argument(
+        "--config", default=None,
+        help="machine config JSON (default: small test config)",
+    )
+    ch.add_argument("--verbose", action="store_true",
+                    help="per-trial progress on stderr")
+    ch.set_defaults(fn=cmd_chaos)
     return p
 
 
 def main(argv=None) -> int:
+    # subprocess chaos activation: a campaign exporting
+    # PRIMETPU_CHAOS_PLAN makes every spawned worker/coordinator/server
+    # inherit the fault plan (no-op when the var is unset)
+    from ..chaos.sites import install_from_env
+
+    install_from_env()
     ns = build_parser().parse_args(argv)
     from ..analysis.errors import AnalysisError, FsckCorrupt
     from ..config.machine import FaultConfigError

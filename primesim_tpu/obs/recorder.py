@@ -104,6 +104,16 @@ class Recorder:
                 "prefix", kind, {k: str(v) for k, v in args.items()}
             )
 
+    def chaos_event(self, site, action, **args) -> None:
+        """Injected-fault instant on the ``chaos`` track — every fault a
+        FaultPlan fires lands here, so a chaotic run's TIMELINE shows
+        exactly what broke, where, and in what order."""
+        if self.trace is not None:
+            self.trace.instant(
+                "chaos", f"{site}:{action}",
+                {k: str(v) for k, v in args.items()}
+            )
+
     def pool_event(self, kind, **args) -> None:
         """Elastic-pool instant (lease, heartbeat, expire, redispatch,
         hedge, ack, duplicate, poison) on the ``pool`` track — the

@@ -15,7 +15,12 @@ campaign must not hang because the OOM killer got lucky N times).
 
 Chaos hook: PRIMETPU_POOL_CRASH="w0:3" makes worker w0 SIGKILL itself at
 its 3rd committed chunk — the deterministic stand-in the crash-recovery
-tests use when pgrep racing would flake.
+tests use when pgrep racing would flake. The env var is now a documented
+ALIAS over the chaos crashpoint registry (DESIGN.md §20): it maps to
+`--crash-after-chunks`, which the worker turns into a one-event
+FaultPlan firing `kill` at the Nth `worker.post-checkpoint` arrival.
+Richer fault schedules use PRIMETPU_CHAOS_PLAN (a plan JSON path) via
+`primetpu chaos`.
 """
 
 from __future__ import annotations

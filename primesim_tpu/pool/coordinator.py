@@ -42,6 +42,7 @@ import socketserver
 import threading
 import time
 
+from ..chaos import sites as chaos
 from ..serve.journal import JobJournal
 from ..serve.protocol import (
     encode,
@@ -80,7 +81,9 @@ class PoolCoordinator:
         # trips — idle workers wait (or --idle-exit) instead of exiting
         self.dynamic = bool(dynamic)
         self.obs = obs
-        self.clock = clock
+        # chaos clock-skew site wraps the lease/expiry clock; with no
+        # plan active this returns `clock` itself (zero overhead)
+        self.clock = chaos.wrap_clock("coordinator.clock", clock)
         # segmentation + compaction keep the pool ledger bounded across
         # long services; pool_compactor preserves fold_unit_records
         self.journal = JobJournal(self.pool_dir,
@@ -229,6 +232,9 @@ class PoolCoordinator:
             "epoch": u["epoch"], "key": u["spec"]["key"],
             "hedge": hedge,
         })
+        # lease journaled, grant not yet delivered: the restart must
+        # re-adopt or expire this lease, never lose the unit
+        chaos.crashpoint("coordinator.post-lease")
         self._pool_event(
             "hedge" if hedge else ("redispatch" if redispatch else "lease"),
             unit=unit_id, worker=worker, epoch=u["epoch"],
@@ -381,6 +387,9 @@ class PoolCoordinator:
             "epoch": epoch, "key": u["spec"]["key"], "result": result,
             "resumed_steps": resumed,
         })
+        # result durable, worker not yet told: a crash here must replay
+        # to DONE and fold the worker's re-ack away as a duplicate
+        chaos.crashpoint("coordinator.post-ack")
         u["state"] = U.DONE
         u["result"] = result
         u["resumed_steps"] = resumed

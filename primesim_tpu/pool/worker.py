@@ -19,20 +19,26 @@ first-ACK-wins means a result computed during a network hole still
 counts when the link returns. Only when the coordinator stays dark past
 `reconnect_timeout_s` does the worker give up (exit 75, EX_TEMPFAIL).
 
-Crash injection for the chaos tests: `crash_after_chunks=N` SIGKILLs
-this process at the Nth committed chunk boundary — a deterministic
-stand-in for the OOM killer. In-process tests use `simulate_crash=True`
-instead, which raises `SimulatedCrash` at the same point (the test then
-plays the role of the dead process by simply not acking).
+Crash injection rides the chaos crashpoint registry (DESIGN.md §20):
+the worker's committed-chunk boundary is the `worker.post-checkpoint`
+site and the moment before its ack is `worker.pre-ack`. The legacy
+`crash_after_chunks=N` knob (and the `PRIMETPU_POOL_CRASH` env alias
+the campaign translates into it) is kept as a documented shorthand: it
+installs a one-event FaultPlan killing this process at the Nth
+`worker.post-checkpoint` arrival. In-process tests use
+`simulate_crash=True`, which swaps the kill for a raised
+`SimulatedCrash` at the same site (the test then plays the role of the
+dead process by simply not acking).
 """
 
 from __future__ import annotations
 
 import os
-import signal
 import threading
 import time
 
+from ..chaos import plan as cplan
+from ..chaos import sites as chaos
 from ..serve.protocol import request
 from ..util.backoff import DecorrelatedJitter, jittered
 
@@ -136,6 +142,19 @@ class PoolWorker:
         self.reconnect_timeout_s = float(reconnect_timeout_s)
         self.crash_after_chunks = crash_after_chunks
         self.simulate_crash = bool(simulate_crash)
+        if crash_after_chunks is not None:
+            # legacy knob -> one-event crashpoint plan. Installing per
+            # construction resets the occurrence counter, matching the
+            # old per-instance `_chunks_seen` semantics exactly.
+            chaos.install(
+                cplan.FaultPlan(seed=0, events=(cplan.FaultEvent(
+                    site="worker.post-checkpoint",
+                    occurrence=int(crash_after_chunks),
+                    action="kill",
+                ),)),
+                mode="raise" if self.simulate_crash else "kill",
+                crash_exc=SimulatedCrash if self.simulate_crash else None,
+            )
         self.rng = rng
         self.idle_exit_s = idle_exit_s
         self.units_done = 0
@@ -215,6 +234,10 @@ class PoolWorker:
         except Exception as e:  # noqa: BLE001 — a bad unit must not kill us
             result = _quarantine_result(unit, e)
             resumed_steps = 0
+        # the unit is fully simulated and checkpointed but NOT acked —
+        # dying here is the classic lost-result window the coordinator's
+        # lease expiry + re-dispatch must absorb
+        chaos.crashpoint("worker.pre-ack")
         try:
             self._call({
                 "verb": "ack",
@@ -241,8 +264,14 @@ class PoolWorker:
         # keep-alive from the moment of the grant: materialization + JIT
         # compilation happen before the first chunk boundary and must not
         # look like a death to the coordinator
-        hb = _Heartbeat(self, unit_id, epoch,
-                        interval_s=max(0.1, ttl / 3.0)).start()
+        hb = _Heartbeat(
+            self, unit_id, epoch,
+            # clock-skew site: a skewed interval makes the worker
+            # heartbeat too slowly and drift into lease expiry
+            interval_s=chaos.clock_skew(
+                "worker.heartbeat.interval", max(0.1, ttl / 3.0)
+            ),
+        ).start()
         try:
             return self._simulate_leased(grant, unit, unit_id, ckpt_path,
                                          hb)
@@ -310,15 +339,11 @@ class PoolWorker:
 
         def on_chunk(sup):
             self._chunks_seen += 1
-            # checkpoint BEFORE the crash-injection point: a worker killed
-            # at chunk N leaves chunk N durable, so the re-lease resumes
-            # exactly where the victim died
+            # checkpoint BEFORE the crashpoint: a worker killed at chunk
+            # N leaves chunk N durable, so the re-lease resumes exactly
+            # where the victim died
             self._checkpoint(ckpt_path, fleet, unit_id)
-            if self.crash_after_chunks is not None \
-                    and self._chunks_seen >= self.crash_after_chunks:
-                if self.simulate_crash:
-                    raise SimulatedCrash(unit_id)
-                os.kill(os.getpid(), signal.SIGKILL)
+            chaos.crashpoint("worker.post-checkpoint")
             hb.steps = int(fleet.steps_run[0])
             if hb.lost:
                 # expired-and-superseded, or the coordinator stayed dark

@@ -3,16 +3,28 @@ the JSON-lines protocol, used by `primetpu submit` / `primetpu
 serve-status` and directly by tests.
 
 Targets are either a unix-socket path or `host:port` (the TCP
-front-end). Connects are bounded by `connect_timeout_s` and retried
-ONCE on a connect-phase failure (`ServeUnavailable` — nothing was sent,
-so the retry cannot double-submit) before the service is reported down;
-post-send failures propagate immediately."""
+front-end). Resilience contract:
+
+- CONNECT-phase failures (`ServeUnavailable` — nothing was sent) retry
+  under decorrelated-jitter backoff for any verb: the retry cannot
+  double-submit because the server never saw the request.
+- POST-SEND failures (plain ConnectionError/OSError — the connection
+  died after bytes left, so the request MAY have been handled and its
+  ACK lost) retry only for verbs marked idempotent. `max_reconnects`
+  defaults to 1 so an interactive CLI reports a dead daemon quickly;
+  long-lived callers (chaos trials, batch drivers) raise it. Reads (status,
+  result, wait, health, metrics) are naturally idempotent; `submit` is
+  MADE idempotent by a client-generated idempotency token — the server
+  answers a retried token with the already-accepted job instead of
+  enqueueing a twin. `cancel` stays single-shot.
+"""
 
 from __future__ import annotations
 
 import time
+import uuid
 
-from ..util.backoff import jittered
+from ..util.backoff import DecorrelatedJitter, jittered
 from .protocol import ServeUnavailable, request
 
 
@@ -30,20 +42,38 @@ class ServeError(RuntimeError):
 
 class ServeClient:
     def __init__(self, target: str, timeout_s: float = 30.0,
-                 connect_timeout_s: float = 5.0):
+                 connect_timeout_s: float = 5.0,
+                 max_reconnects: int = 1, rng=None):
         self.target = str(target)
         self.socket_path = self.target  # legacy alias (pre-TCP callers)
         self.timeout_s = float(timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
+        self.max_reconnects = int(max_reconnects)
+        self.rng = rng
+        self.reconnects = 0  # observable retry count (tests/diagnostics)
 
-    def _call(self, req: dict, timeout_s: float | None = None) -> dict:
-        try:
-            reply = self._request(req, timeout_s)
-        except ServeUnavailable:
-            # connect never completed: one jittered retry before the
-            # service is declared down (front-end failover window)
-            time.sleep(jittered(0.2))
-            reply = self._request(req, timeout_s)
+    def _call(self, req: dict, timeout_s: float | None = None,
+              idempotent: bool = False) -> dict:
+        """One verb round-trip under the resilience contract above."""
+        jitter = DecorrelatedJitter(base=0.2, cap=3.0, rng=self.rng)
+        attempt = 0
+        while True:
+            try:
+                reply = self._request(req, timeout_s)
+                break
+            except ServeUnavailable:
+                # connect never completed: always safe to retry
+                if attempt >= self.max_reconnects:
+                    raise
+            except (ConnectionError, OSError):
+                # post-send: the server may have handled the request and
+                # the reply died on the wire — only a token-carrying or
+                # read-only request may be replayed
+                if not idempotent or attempt >= self.max_reconnects:
+                    raise
+            attempt += 1
+            self.reconnects += 1
+            time.sleep(jitter.next_delay())
         if not reply.get("ok", False):
             raise ServeError(reply)
         return reply
@@ -66,9 +96,12 @@ class ServeClient:
         priority: int = 0,
         client: str = "anon",
         retries: int = 0,
+        idem: str | None = None,
     ) -> dict:
         """Submit one job; the reply's job is ACKed = durably journaled.
-        With `retries`, honors RETRY_AFTER backpressure by sleeping and
+        A fresh idempotency token is generated unless `idem` is given,
+        so transparent reconnect-retries cannot double-enqueue. With
+        `retries`, honors RETRY_AFTER backpressure by sleeping and
         resubmitting up to that many times."""
         req = {
             "verb": "submit",
@@ -80,31 +113,35 @@ class ServeClient:
             "max_steps": max_steps,
             "priority": priority,
             "client": client,
+            "idem": idem or uuid.uuid4().hex,
         }
         attempt = 0
         while True:
             try:
-                return self._call(req)["job"]
+                return self._call(req, idempotent=True)["job"]
             except ServeError as e:
                 if e.retry_after_s is None or attempt >= retries:
                     raise
                 attempt += 1
                 # jitter the server's hint (util.backoff): N clients told
                 # "retry in 5s" must not resubmit in the same instant
-                time.sleep(jittered(float(e.retry_after_s)))
+                time.sleep(jittered(float(e.retry_after_s), rng=self.rng))
 
     def status(self, job_id: str | None = None) -> dict | list:
-        reply = self._call({"verb": "status", "job_id": job_id})
+        reply = self._call({"verb": "status", "job_id": job_id},
+                           idempotent=True)
         return reply["job"] if job_id else reply["jobs"]
 
     def result(self, job_id: str) -> dict:
-        return self._call({"verb": "result", "job_id": job_id})
+        return self._call({"verb": "result", "job_id": job_id},
+                          idempotent=True)
 
     def wait(self, job_id: str, timeout_s: float = 300.0) -> dict:
         """Block until the job is terminal; returns its public view."""
         reply = self._call(
             {"verb": "wait", "job_id": job_id, "timeout_s": timeout_s},
             timeout_s=timeout_s + 10.0,
+            idempotent=True,
         )
         return reply["job"]
 
@@ -112,11 +149,11 @@ class ServeClient:
         return self._call({"verb": "cancel", "job_id": job_id})["job"]
 
     def health(self) -> dict:
-        return self._call({"verb": "health"})
+        return self._call({"verb": "health"}, idempotent=True)
 
     def metrics(self) -> str:
         """Prometheus text exposition from the daemon's `metrics` verb."""
-        return self._call({"verb": "metrics"})["text"]
+        return self._call({"verb": "metrics"}, idempotent=True)["text"]
 
     def drain(self) -> dict:
-        return self._call({"verb": "drain"})
+        return self._call({"verb": "drain"}, idempotent=True)

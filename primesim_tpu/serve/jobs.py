@@ -62,6 +62,10 @@ class Job:
     max_steps: int = 10_000_000
     priority: int = 0
     accepted_t: float = field(default_factory=time.time)
+    # client-supplied idempotency token: a retried submit after a lost
+    # ACK presents the same token and is answered with THIS job instead
+    # of double-enqueueing (journaled, so dedup survives restart)
+    idem: str | None = None
     # mutable progress (not part of the accept record)
     state: str = PENDING
     detail: dict = field(default_factory=dict)
@@ -127,6 +131,7 @@ class Job:
             "max_steps": self.max_steps,
             "priority": self.priority,
             "accepted_t": self.accepted_t,
+            "idem": self.idem,
         }
 
     @classmethod

@@ -63,6 +63,7 @@ import re
 import time
 import zlib
 
+from ..chaos import sites as chaos
 from ..obs.metrics import Histogram
 
 #: default active-segment record cap before a roll; None = never roll
@@ -294,6 +295,7 @@ class JobJournal:
             self._roll()
         t0 = time.perf_counter()
         line = _frame(rec)
+        chaos.durable("journal.append", f=self._f, data=line + "\n")
         self._f.write(line + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())

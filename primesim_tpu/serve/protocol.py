@@ -34,6 +34,8 @@ from __future__ import annotations
 import json
 import socket
 
+from ..chaos import sites as chaos
+
 MAX_LINE = 1 << 20  # 1 MiB per message — traces travel by path, not value
 
 
@@ -119,12 +121,18 @@ def decode(line: bytes | str) -> dict:
 
 def read_line(f) -> dict | None:
     """Read one framed message from a file-like socket reader; None on
-    EOF (peer closed)."""
+    EOF (peer closed). A partial line at EOF — the peer died mid-frame —
+    is a TORN FRAME, rejected as such rather than handed to the JSON
+    decoder: a truncated frame that happened to parse would silently
+    become a different message."""
     line = f.readline(MAX_LINE + 1)
     if not line:
         return None
     if len(line) > MAX_LINE:
         raise ValueError("oversized protocol message")
+    nl = b"\n" if isinstance(line, bytes) else "\n"
+    if not line.endswith(nl):
+        raise ValueError("torn protocol frame (peer closed mid-message)")
     return decode(line)
 
 
@@ -171,8 +179,11 @@ def request(target, req: dict, timeout_s: float = 30.0,
                  if connect_timeout_s is not None else timeout_s)
     try:
         s.settimeout(timeout_s)
-        s.sendall(encode(req))
+        payload = encode(req)
+        if not chaos.socket_send("protocol.send", s, payload):
+            s.sendall(payload)
         f = s.makefile("rb")
+        chaos.socket_recv("protocol.recv", s)
         reply = read_line(f)
     finally:
         s.close()

@@ -36,6 +36,7 @@ import time
 
 import numpy as np
 
+from ..chaos import sites as chaos
 from ..obs.metrics import Histogram
 from ..sim.fleet import FleetEngine, apply_overrides
 from ..sim.supervisor import JobContext, validate_fleet_element
@@ -297,6 +298,9 @@ class Scheduler:
             )
         self.jobs[job.job_id] = job
         self.journal.accept(job)
+        # the accept record is durable but the caller has NOT been told:
+        # dying here is the lost-ACK window idempotency tokens cover
+        chaos.crashpoint("server.post-journal-pre-ack")
         self._serve_event("admit", job_id=job.job_id, client=job.client,
                           priority=job.priority)
         self._validate_or_quarantine(job)
@@ -368,12 +372,14 @@ class Scheduler:
         for b in self.buckets:
             if not b.busy():
                 continue
+            chaos.crashpoint("scheduler.pre-dispatch")
             try:
                 b.fleet.step_chunk()
                 worked = True
             except Exception as e:  # noqa: BLE001 — classified below
                 self._dispatch_failed(b, e)
                 return True
+            chaos.crashpoint("scheduler.post-dispatch")
         self._harvest(now)
         # promotion check runs BETWEEN chunks: a windowed job must leave
         # its small bucket before the next chunk could reach the window
@@ -382,6 +388,7 @@ class Scheduler:
         if now - self._last_ckpt_t >= self.checkpoint_every_s:
             self.checkpoint_running()
             self._last_ckpt_t = now
+            chaos.crashpoint("scheduler.post-checkpoint")
         return worked
 
     def pending_work(self) -> bool:

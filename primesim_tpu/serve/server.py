@@ -193,12 +193,24 @@ class PrimeServer:
             out = {"ok": False, "retry_after_s": 5.0}
             out.update(error_obj(RuntimeError("server is draining")))
             return out
+        idem = req.get("idem")
+        if idem:
+            # idempotent resubmit: a client retrying after a lost ACK
+            # (or a duplicated frame) presents the same token; answer
+            # with the already-accepted job. Tokens ride the accept
+            # record, so the dedup also holds across a server restart.
+            for j in self.sched.jobs.values():
+                if j.idem == str(idem) \
+                        and j.client == str(req.get("client", "anon")):
+                    return {"ok": True, "job": j.public(),
+                            "duplicate": True}
         if self.quota is not None:
             # admission quota spends a token BEFORE a job id exists, so
             # rejected submits leave no trace in the journal or job table
             self.quota.admit(str(req.get("client", "anon")))
         job = J.Job(
             job_id=self.sched.next_job_id(),
+            idem=str(idem) if idem else None,
             client=str(req.get("client", "anon")),
             trace_path=req.get("trace_path"),
             synth=req.get("synth"),

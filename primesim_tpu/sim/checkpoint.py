@@ -31,6 +31,7 @@ import zlib
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import sites as chaos
 from ..config.machine import MachineConfig
 from ..faults.schedule import FaultState
 from ..stats.counters import COUNTER_NAMES
@@ -101,6 +102,9 @@ def atomic_save_npz(path: str, **arrays) -> None:
             np.savez_compressed(f, **named)
             f.flush()
             os.fsync(f.fileno())
+        # chaos durable-write site: a torn/fsync fault here dies BEFORE
+        # the rename, proving `path` keeps its previous complete snapshot
+        chaos.durable("checkpoint.write", path=tmp)
         os.replace(tmp, path)
         # fsync the directory so the rename itself survives power loss
         dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)

@@ -1,0 +1,417 @@
+"""Chaos subsystem (DESIGN.md §20): plan determinism, per-site fault
+trials, the shrinker, idempotent submit, torn-frame protocol handling,
+and the invariant-checked campaign end to end."""
+
+import io
+import json
+import os
+import time
+
+import pytest
+
+from primesim_tpu.chaos import campaign as C
+from primesim_tpu.chaos import plan as P
+from primesim_tpu.chaos import sites
+from primesim_tpu.config.machine import small_test_config
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_runtime():
+    """Chaos state is process-global; no test may leak an active plan."""
+    sites.deactivate()
+    yield
+    sites.deactivate()
+
+
+@pytest.fixture(scope="module")
+def golden():
+    sites.deactivate()
+    return C.golden_run()
+
+
+def _ev(site, occ, action, **args):
+    return P.FaultEvent(site=site, occurrence=occ, action=action,
+                        args=tuple(sorted(args.items())))
+
+
+# ---- plans ---------------------------------------------------------------
+
+
+def test_plan_generation_deterministic():
+    a = P.generate(42)
+    b = P.generate(42)
+    assert a == b
+    assert a.events  # at least one event
+    assert P.generate(43) != a or P.generate(44) != a
+
+
+def test_plan_json_round_trip():
+    plan = P.generate(7, classes=("durable", "crashpoint", "socket"))
+    again = P.FaultPlan.from_json(plan.to_json())
+    assert again == plan
+    # and through a file (the artifact path)
+    d = json.loads(plan.to_json())
+    assert d["seed"] == 7
+    assert all(ev["site"] in sites.SITES for ev in d["events"])
+
+
+def test_plan_events_unique_site_occurrence():
+    for seed in range(50):
+        plan = P.generate(seed, classes=("durable", "crashpoint",
+                                         "socket", "clock"))
+        keys = [(e.site, e.occurrence) for e in plan.events]
+        assert len(keys) == len(set(keys))
+        for e in plan.events:
+            cls = sites.SITES[e.site]
+            assert e.action in P.ACTIONS[cls]
+
+
+def test_recv_sites_never_draw_send_actions():
+    for seed in range(80):
+        plan = P.generate(seed, classes=("socket",))
+        for e in plan.events:
+            if e.site.endswith(".recv"):
+                assert e.action in ("disconnect", "delay")
+
+
+def test_plan_save_load(tmp_path):
+    plan = P.generate(3)
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    assert P.FaultPlan.load(path) == plan
+
+
+# ---- runtime semantics ---------------------------------------------------
+
+
+def test_events_fire_once_and_occurrences_count():
+    plan = P.FaultPlan(seed=0, events=(
+        _ev("scheduler.pre-dispatch", 2, "kill"),
+    ))
+    rt = sites.install(plan, mode="raise")
+    sites.crashpoint("scheduler.pre-dispatch")  # occurrence 1: no fire
+    with pytest.raises(sites.ChaosCrash):
+        sites.crashpoint("scheduler.pre-dispatch")  # occurrence 2
+    # fired events never re-fire, even at the same count
+    sites.crashpoint("scheduler.pre-dispatch")
+    sites.crashpoint("scheduler.pre-dispatch")
+    assert rt.counts["scheduler.pre-dispatch"] == 4
+    assert rt.injected == [{"site": "scheduler.pre-dispatch",
+                            "occurrence": 2, "action": "kill"}]
+
+
+def test_chaoscrash_is_not_swallowed_by_except_exception():
+    assert not issubclass(sites.ChaosCrash, Exception)
+    plan = P.FaultPlan(seed=0, events=(_ev("worker.pre-ack", 1, "kill"),))
+    sites.install(plan, mode="raise")
+    with pytest.raises(sites.ChaosCrash):
+        try:
+            sites.crashpoint("worker.pre-ack")
+        except Exception:  # noqa: BLE001 — the boundary under test
+            pytest.fail("protocol boundary absorbed an injected crash")
+
+
+def test_no_plan_hooks_are_inert():
+    assert sites.runtime() is None
+    sites.crashpoint("worker.pre-ack")
+    sites.durable("journal.append", f=None, data=b"x")
+    assert sites.clock_skew("coordinator.clock", 5.0) == 5.0
+    clock = time.monotonic
+    assert sites.wrap_clock("coordinator.clock", clock) is clock
+
+
+def test_clock_skew_persists_after_event():
+    plan = P.FaultPlan(seed=0, events=(
+        _ev("coordinator.clock", 2, "skew", offset_s=10.0),
+    ))
+    sites.install(plan, mode="raise")
+    assert sites.clock_skew("coordinator.clock", 100.0) == 100.0
+    assert sites.clock_skew("coordinator.clock", 100.0) == 110.0
+    assert sites.clock_skew("coordinator.clock", 100.0) == 110.0
+
+
+# ---- per-site-class trials (in-process serve stack) ----------------------
+
+
+def test_torn_journal_write_trial(golden):
+    plan = P.FaultPlan(seed=1, events=(
+        _ev("journal.append", 2, "torn", frac=0.4),
+    ))
+    res = C.run_serve_trial(plan, golden=golden)
+    assert res.ok, res.violations
+    assert res.restarts == 1
+    assert res.injected[0]["site"] == "journal.append"
+
+
+def test_fsync_failure_trial(golden):
+    plan = P.FaultPlan(seed=2, events=(
+        _ev("journal.append", 1, "fsync_fail"),
+    ))
+    res = C.run_serve_trial(plan, golden=golden)
+    assert res.ok, res.violations
+    assert res.restarts == 1
+
+
+def test_checkpoint_torn_trial(golden):
+    plan = P.FaultPlan(seed=3, events=(
+        _ev("checkpoint.write", 2, "torn", frac=0.3),
+    ))
+    res = C.run_serve_trial(plan, golden=golden)
+    assert res.ok, res.violations
+
+
+def test_ack_window_crashpoint_trial(golden):
+    """Death between the durable accept and the ACK: the client never
+    heard yes, the idempotent resubmit must find the journaled job."""
+    plan = P.FaultPlan(seed=4, events=(
+        _ev("server.post-journal-pre-ack", 1, "kill"),
+    ))
+    res = C.run_serve_trial(plan, golden=golden)
+    assert res.ok, res.violations
+    assert res.restarts == 1
+
+
+def test_scheduler_crashpoints_trial(golden):
+    plan = P.FaultPlan(seed=5, events=(
+        _ev("scheduler.pre-dispatch", 2, "kill"),
+        _ev("scheduler.post-checkpoint", 3, "kill"),
+    ))
+    res = C.run_serve_trial(plan, golden=golden)
+    assert res.ok, res.violations
+    assert res.restarts == 2
+
+
+def test_socket_disconnect_trial(golden):
+    """Lost reply on the wire: the submit's ACK dies with the
+    connection; the client's token-carrying retry must not twin the
+    job."""
+    plan = P.FaultPlan(seed=6, events=(
+        _ev("protocol.recv", 1, "disconnect"),
+        _ev("protocol.send", 3, "short_send", frac=0.5),
+    ))
+    res = C.run_socket_trial(plan, golden=golden)
+    assert res.ok, res.violations
+    assert len(res.injected) == 2
+
+
+# ---- the worker's legacy crash knob rides the registry -------------------
+
+
+def test_worker_crash_knob_installs_crashpoint_plan(tmp_path):
+    from primesim_tpu.pool.worker import PoolWorker, SimulatedCrash
+
+    PoolWorker(str(tmp_path / "sock"), "wX",
+               crash_after_chunks=2, simulate_crash=True)
+    rt = sites.runtime()
+    assert rt is not None and rt.mode == "raise"
+    [ev] = rt.plan.events
+    assert (ev.site, ev.occurrence) == ("worker.post-checkpoint", 2)
+    sites.crashpoint("worker.post-checkpoint")  # chunk 1: survives
+    with pytest.raises(SimulatedCrash):
+        sites.crashpoint("worker.post-checkpoint")  # chunk 2: dies
+
+
+# ---- S3: torn-frame protocol regression ----------------------------------
+
+
+def test_read_line_rejects_torn_frame():
+    from primesim_tpu.serve.protocol import read_line
+
+    with pytest.raises(ValueError, match="torn protocol frame"):
+        read_line(io.BytesIO(b'{"verb":"sub'))
+    with pytest.raises(ValueError, match="torn protocol frame"):
+        # a torn frame that still PARSES as JSON must not slip through
+        read_line(io.BytesIO(b'{"ok":true}'))
+
+
+def test_read_line_full_frame_and_eof():
+    from primesim_tpu.serve.protocol import read_line
+
+    assert read_line(io.BytesIO(b'{"ok":true}\n')) == {"ok": True}
+    assert read_line(io.BytesIO(b"")) is None
+
+
+# ---- S2: idempotent client ------------------------------------------------
+
+
+def test_client_post_send_retry_only_for_idempotent(monkeypatch):
+    from primesim_tpu.serve.client import ServeClient
+
+    calls = {"n": 0}
+
+    def flaky(target, req, timeout_s=30.0, connect_timeout_s=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("link died post-send")
+        return {"ok": True}
+
+    monkeypatch.setattr("primesim_tpu.serve.client.request", flaky)
+    cli = ServeClient("sock", timeout_s=1.0, max_reconnects=2)
+    assert cli._call({"verb": "status"}, idempotent=True)["ok"]
+    assert calls["n"] == 2 and cli.reconnects == 1
+
+    calls["n"] = 0
+    with pytest.raises(ConnectionError):
+        cli._call({"verb": "cancel"})  # not idempotent: no retry
+    assert calls["n"] == 1
+
+
+def test_submit_generates_idempotency_token(monkeypatch):
+    from primesim_tpu.serve.client import ServeClient
+
+    seen = []
+
+    def capture(target, req, timeout_s=30.0, connect_timeout_s=None):
+        seen.append(req)
+        return {"ok": True, "job": {"job_id": "j000001"}}
+
+    monkeypatch.setattr("primesim_tpu.serve.client.request", capture)
+    cli = ServeClient("sock")
+    cli.submit(synth="s")
+    cli.submit(synth="s", idem="tok-7")
+    assert seen[0]["idem"] and len(seen[0]["idem"]) == 32
+    assert seen[1]["idem"] == "tok-7"
+    assert seen[0]["idem"] != seen[1]["idem"]
+
+
+def test_server_dedups_idempotency_token(tmp_path):
+    from primesim_tpu.serve.server import PrimeServer
+
+    srv = PrimeServer(
+        small_test_config(4), state_dir=str(tmp_path / "srv"),
+        buckets=((2, 1),), chunk_steps=16,
+    )
+    req = {"verb": "submit", "idem": "tok",
+           "synth": "fft_like:n_phases=1,points_per_core=8,seed=1"}
+    first = srv._handle(dict(req))
+    again = srv._handle(dict(req))
+    assert first["ok"] and again["ok"]
+    assert again["duplicate"] is True
+    assert again["job"]["job_id"] == first["job"]["job_id"]
+    assert len(srv.sched.jobs) == 1
+    # a DIFFERENT token is a different request
+    third = srv._handle({**req, "idem": "tok2"})
+    assert third["job"]["job_id"] != first["job"]["job_id"]
+    srv.journal.close()
+
+
+def test_idem_token_survives_journal_replay(tmp_path):
+    from primesim_tpu.serve import jobs as J
+    from primesim_tpu.serve.journal import JobJournal, fold_records
+
+    d = str(tmp_path / "j")
+    os.makedirs(d)
+    j = JobJournal(d)
+    j.accept(J.Job(job_id="j000001", idem="tok-x", synth="s"))
+    j.close()
+    recs, _ = JobJournal(d).replay()
+    jobs, _ = fold_records(recs)
+    assert jobs["j000001"].idem == "tok-x"
+
+
+# ---- shrinker ------------------------------------------------------------
+
+
+def test_shrinker_finds_minimal_event_set():
+    culprit = _ev("journal.append", 3, "torn", frac=0.5)
+    plan = P.FaultPlan(seed=9, events=(
+        _ev("scheduler.pre-dispatch", 1, "kill"),
+        culprit,
+        _ev("checkpoint.write", 2, "delay", s=0.001),
+    ))
+    trials = []
+
+    def still_fails(p):
+        trials.append(p)
+        return culprit in p.events
+
+    shrunk = P.shrink(plan, still_fails)
+    assert shrunk.events == (culprit,)
+    assert trials  # the predicate actually drove the search
+
+
+def test_shrinker_keeps_interacting_pair():
+    a = _ev("journal.append", 1, "torn", frac=0.5)
+    b = _ev("scheduler.post-checkpoint", 1, "kill")
+    plan = P.FaultPlan(seed=10, events=(
+        a, b, _ev("checkpoint.write", 4, "delay", s=0.001),
+    ))
+    shrunk = P.shrink(
+        plan, lambda p: a in p.events and b in p.events
+    )
+    assert set(shrunk.events) == {a, b}
+
+
+# ---- the campaign catches a real durability bug --------------------------
+
+
+def test_deliberate_ack_before_fsync_bug_caught(tmp_path, golden,
+                                               monkeypatch):
+    """Break the ACK invariant on purpose (accept returns without
+    journaling) and the ack-window crashpoint must surface it as an
+    invariant-A violation with a shrunk, replayable artifact."""
+    from primesim_tpu.serve.journal import JobJournal
+
+    monkeypatch.setattr(JobJournal, "accept", lambda self, job: None)
+    # the crash must land AFTER the ACKs (submit returned) — dispatch of
+    # the first chunk is exactly that window
+    plan = P.FaultPlan(seed=77, events=(
+        _ev("scheduler.pre-dispatch", 1, "kill"),
+        _ev("checkpoint.write", 9, "delay", s=0.001),  # innocent rider
+    ))
+    res = C.run_serve_trial(plan, golden=golden)
+    assert not res.ok
+    assert any("invariant A" in v for v in res.violations)
+
+    # shrink against the invariant that actually broke (fsck alone also
+    # catches this bug, so a generic not-ok predicate would accept ANY
+    # event set, including the empty-ish rider)
+    def lost_ack(p):
+        r = C.run_serve_trial(p, golden=golden)
+        return any("invariant A" in v for v in r.violations)
+
+    shrunk = P.shrink(plan, lost_ack)
+    assert len(shrunk.events) == 1
+    assert shrunk.events[0].site == "scheduler.pre-dispatch"
+
+    art = str(tmp_path / "repro.json")
+    with open(art, "w") as f:
+        json.dump({"seed": 77, "plan": shrunk.as_dict(),
+                   "violations": res.violations}, f)
+    replay = C.replay_artifact(art)
+    assert not replay.ok  # bug still in place: artifact reproduces
+
+
+def test_fixed_bug_makes_artifact_pass(tmp_path, golden):
+    """The same artifact goes green once the bug is gone — the repro
+    loop's exit condition."""
+    art = str(tmp_path / "repro.json")
+    plan = P.FaultPlan(seed=77, events=(
+        _ev("scheduler.pre-dispatch", 1, "kill"),
+    ))
+    with open(art, "w") as f:
+        json.dump({"seed": 77, "plan": plan.as_dict()}, f)
+    assert C.replay_artifact(art).ok
+
+
+# ---- e2e seeded campaign --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_seeded_campaign_clean(tmp_path):
+    report = C.run_campaign(
+        n_trials=6, seed0=900, classes=("durable", "crashpoint"),
+        artifact_dir=str(tmp_path / "art"),
+    )
+    assert report["ok"], report["violations"]
+    assert report["trials"] == 6
+    assert report["fired_events"] > 0  # the plans actually bit
+
+
+@pytest.mark.slow
+def test_campaign_cli_verb(tmp_path):
+    from primesim_tpu.cli import main
+
+    rc = main(["chaos", "--trials", "2", "--seed", "321",
+               "--classes", "durable"])
+    assert rc == 0
