@@ -221,6 +221,118 @@ def _check_journal_dir(dirpath: str, root: str) -> tuple:
     return records, findings
 
 
+# ---- chain comparison (fsck --compare) ----------------------------------
+
+
+def _flatten_chain(dirpath: str):
+    """One journal directory -> (base_seq, [(seq, raw_line), ...],
+    findings): every valid framed line from the newest BASE onward, in
+    append order, torn tail in the newest segment excluded (it is by
+    definition not durable). Raw LINES, not records — replication ships
+    bytes, so agreement is judged on bytes."""
+    from ..serve.journal import _SEG_RE, _unframe
+
+    segments = []
+    for name in os.listdir(dirpath):
+        m = _SEG_RE.match(name)
+        if m:
+            segments.append((int(m.group(1)),
+                             os.path.join(dirpath, name)))
+    segments.sort()
+    active = os.path.join(dirpath, _JOURNAL_ACTIVE)
+    if os.path.exists(active):
+        seq = segments[-1][0] + 1 if segments else 0
+        lines = _scan_lines_ro(active)
+        if lines:
+            first = _unframe(lines[0])
+            if first is not None and first.get("t") == "seg":
+                seq = int(first.get("seq", seq))
+        segments.append((seq, active))
+
+    parsed = []
+    findings: list = []
+    base_seq = segments[0][0] if segments else 0
+    for seq, path in segments:
+        rel = os.path.basename(path)
+        newest = path == segments[-1][1]
+        lines = _scan_lines_ro(path)
+        kept = []
+        for line in lines:
+            rec = _unframe(line)
+            if rec is None:
+                if not newest:
+                    findings.append(Finding(
+                        "journal-record", rel,
+                        "bad line in a closed segment (compare runs on "
+                        "top of a chain fsck — fix that first)",
+                        corrupt=True,
+                    ))
+                break  # torn tail: everything after is not durable
+            if rec.get("t") == "seg" and kept == [] \
+                    and rec.get("base"):
+                base_seq = max(base_seq, seq)
+            kept.append((seq, line))
+        parsed.extend(kept)
+    return base_seq, [p for p in parsed if p[0] >= base_seq], findings
+
+
+def run_compare(dir_a: str, dir_b: str) -> FsckResult:
+    """`primetpu fsck --compare A B`: frame-for-frame agreement of two
+    journal chains up to the SHORTER one's durable point — the offline
+    proof that a primary and a replica really are bit-identical
+    (DESIGN.md §21). Chains are aligned at the newer of the two
+    compaction BASEs; a divergent frame is corrupt (exit 2), one chain
+    being a strict prefix of the other is clean (a follower mid
+    catch-up is behind, not wrong)."""
+    from ..serve.journal import _line_crc
+
+    for d in (dir_a, dir_b):
+        if not os.path.isdir(d):
+            raise FsckCorrupt(f"not a directory: {d}", path=d)
+    findings: list = []
+    base_a, chain_a, fa = _flatten_chain(dir_a)
+    base_b, chain_b, fb = _flatten_chain(dir_b)
+    findings.extend(fa)
+    findings.extend(fb)
+
+    # align at the newer BASE: the chain with the older base still
+    # carries pre-compaction history the other one folded away
+    base = max(base_a, base_b)
+    chain_a = [p for p in chain_a if p[0] >= base]
+    chain_b = [p for p in chain_b if p[0] >= base]
+    label = f"{dir_a} <> {dir_b}"
+    checked = {"frames_a": len(chain_a), "frames_b": len(chain_b),
+               "frames_compared": 0, "base_seq": base}
+
+    if not chain_a or not chain_b:
+        findings.append(Finding(
+            "journal-compare", label,
+            f"no overlapping segments at or past base {base} "
+            f"(A starts at base {base_a}, B at {base_b}) — one side is "
+            "behind a compaction it never resynced from; nothing is "
+            "comparable", corrupt=False,
+        ))
+    else:
+        n = min(len(chain_a), len(chain_b))
+        checked["frames_compared"] = n
+        for i in range(n):
+            seq_a, line_a = chain_a[i]
+            seq_b, line_b = chain_b[i]
+            if seq_a != seq_b or line_a != line_b:
+                findings.append(Finding(
+                    "journal-compare", label,
+                    f"frame {i} diverges: A seg {seq_a} crc "
+                    f"{_line_crc(line_a)} vs B seg {seq_b} crc "
+                    f"{_line_crc(line_b)} — the chains are not copies "
+                    "of one history", corrupt=True,
+                ))
+                break
+
+    findings.sort(key=lambda f: (f.path, f.kind, f.detail))
+    return FsckResult(root=label, findings=findings, checked=checked,
+                      quarantined=[])
+
+
 # ---- record-stream legality --------------------------------------------
 
 
@@ -541,13 +653,21 @@ def render_human(res: FsckResult) -> str:
     for p in res.quarantined:
         out.append(f"quarantined: {p} -> .fsck-quarantine/{p}")
     c = res.checked
-    out.append(
-        f"checked {c['journals']} journal(s) / {c['records']} record(s), "
-        f"{c['checkpoints']} checkpoint(s), {c['warm_entries']} warm "
-        f"entr(ies), {c['orphans']} orphan(s): "
-        f"{len(res.corrupt)} corrupt, "
-        f"{len(res.findings) - len(res.corrupt)} note(s)"
-    )
+    if "frames_compared" in c:  # --compare mode
+        out.append(
+            f"compared {c['frames_compared']} frame(s) from base seg "
+            f"{c['base_seq']} (A holds {c['frames_a']}, B holds "
+            f"{c['frames_b']}): {len(res.corrupt)} corrupt, "
+            f"{len(res.findings) - len(res.corrupt)} note(s)"
+        )
+    else:
+        out.append(
+            f"checked {c['journals']} journal(s) / {c['records']} "
+            f"record(s), {c['checkpoints']} checkpoint(s), "
+            f"{c['warm_entries']} warm entr(ies), {c['orphans']} "
+            f"orphan(s): {len(res.corrupt)} corrupt, "
+            f"{len(res.findings) - len(res.corrupt)} note(s)"
+        )
     return "\n".join(out)
 
 

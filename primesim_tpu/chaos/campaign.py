@@ -56,6 +56,15 @@ SERVE_SITES = {
     "socket": ("protocol.send", "protocol.recv"),
 }
 
+#: Sites only the replication trial reaches. Opt-in via `--classes
+#: replication` — they are NOT folded into the default campaign, so
+#: plain durable/crashpoint runs keep their historical trial shape.
+#: `replica.pre-fsync-ack` is crashpoint-CLASS (its only action is
+#: kill) but replication-trial-ONLY, so listing "replication" pulls it
+#: in: a replication campaign without replica deaths would never
+#: exercise catch-up or promotion-under-loss.
+REPLICATION_SITES = ("replicate.send", "replica.pre-fsync-ack")
+
 #: Small deterministic workloads (serve's synth grammar). Distinct seeds
 #: give distinct results, so a cross-wired job table fails invariant B.
 DEFAULT_SPECS = (
@@ -398,6 +407,296 @@ def run_socket_trial(
                        injected=injected)
 
 
+# ---- the replication trial (primary + replicas + fenced failover) --------
+
+_REIGN1_TICKS = 40  # primary A's tick budget before the injected host loss
+
+
+def _boot_replicated(state_dir, cfg, buckets, chunk_steps, targets, node):
+    """`_boot` plus the replication sink: journal -> sink -> NEW FENCING
+    EPOCH -> recover — the exact order the real server uses, so the
+    epoch frame is the first record of every reign."""
+    from ..serve.journal import JobJournal, fold_records, serve_compactor
+    from ..serve.replicate import ReplicationSink
+    from ..serve.scheduler import Scheduler
+
+    journal = JobJournal(state_dir, compactor=serve_compactor)
+    sink = ReplicationSink(journal, list(targets), policy="block",
+                           node=node)
+    journal.sink = sink
+    sink.begin_epoch()
+    sched = Scheduler(
+        cfg, journal, state_dir, buckets=buckets, chunk_steps=chunk_steps,
+        checkpoint_every_s=0.0,
+    )
+    records, _dropped = journal.replay()
+    jobs, _clean = fold_records(records)
+    for job in jobs.values():
+        if job.terminal:
+            sched.adopt_terminal(job)
+        else:
+            sched.requeue_recovered(job)
+    if jobs:
+        sched._seq = max(
+            (int(j.job_id[1:]) for j in jobs.values()
+             if j.job_id.startswith("j") and j.job_id[1:].isdigit()),
+            default=0,
+        )
+    return sched, sink
+
+
+def _submit_quorum(sched, sink, specs, idems, acked, violations) -> None:
+    """`_submit_missing`, quorum-aware: a submit only counts as ACKed
+    when its frames reached the replica quorum — exactly what the real
+    server promises the client. A below-quorum submit stays un-ACKed
+    and is retried (same idempotency token) once quorum returns; the
+    fold-side dedup turning that retry into an adoption is invariant D's
+    business."""
+    from ..serve import jobs as J
+
+    for i in range(len(specs)):
+        jid = acked.get(i)
+        if jid is not None:
+            if jid not in sched.jobs:
+                violations.append(
+                    f"invariant A: ACKed job {jid} (spec {i}) lost after "
+                    "failover"
+                )
+            continue
+        dup = next(
+            (j for j in sched.jobs.values() if j.idem == idems[i]), None
+        )
+        if dup is not None:
+            acked[i] = dup.job_id  # lost-ACK retry answered by dedup
+            continue
+        if not sink.quorum_ok():
+            continue  # admission blocked: correctly NOT ACKed
+        job = J.Job(job_id=sched.next_job_id(), idem=idems[i],
+                    client="chaos", synth=specs[i])
+        sched.submit(job)
+        if sink.quorum_ok():
+            acked[i] = job.job_id  # quorum ACK observed by the client
+
+
+def _reborn(replicas, targets) -> None:
+    """Restart every chaos-killed replica over its SURVIVING directory
+    (the disk outlives the process) on a fresh port — the operator
+    action that restores quorum. In-place list mutation so the caller's
+    next sink sees the new targets."""
+    from ..serve.replicate import ReplicaServer
+
+    for i, rep in enumerate(replicas):
+        if not rep.dead:
+            continue
+        try:
+            rep._srv.server_close()
+        except (OSError, AttributeError):
+            pass
+        fresh = ReplicaServer(rep.store.dir, "127.0.0.1:0")
+        replicas[i] = fresh
+        targets[i] = fresh.start()
+
+
+def run_replication_trial(
+    plan: P.FaultPlan,
+    cfg=None,
+    specs=DEFAULT_SPECS,
+    golden: dict | None = None,
+    workdir: str | None = None,
+    keep_dir: bool = False,
+    buckets=((2, 1),),
+    chunk_steps: int = 16,
+) -> TrialResult:
+    """One seeded trial of the replicated-journal story (DESIGN.md §21):
+
+    1. primary A (quorum-blocking sink over two in-process replicas)
+       submits the workload and ticks under the plan's partitions,
+       delivery duplicates, link delays and replica kills;
+    2. A's HOST is lost mid-flight (we stop driving it but keep its
+       sink alive for the dual-primary probe); dead replicas are
+       rebooted over their surviving disks;
+    3. standby B promotes: pulls the longest replica chain, opens a
+       higher fencing epoch, re-admits anything never ACKed, finishes
+       every job;
+    4. the deposed A then attempts a quorum round — if it can still
+       ACK, that is INVARIANT E (dual primary) and the trial fails;
+    5. checks: A (no quorum-ACKed job lost across the failover),
+       B (bit-exact vs golden), C (fsck clean over B's dir),
+       D (no idempotency twins), E (above), plus `fsck --compare` of
+       B's chain against each surviving replica chain.
+    """
+    from ..analysis.fsck import run_compare, run_fsck
+    from ..serve.replicate import ReplicaServer
+
+    cfg = cfg or _default_cfg()
+    if golden is None:
+        golden = golden_run(cfg, specs, buckets=buckets,
+                            chunk_steps=chunk_steps, workdir=workdir)
+    root = tempfile.mkdtemp(prefix="chaos-repl-", dir=workdir)
+    a_dir = os.path.join(root, "primary-a")
+    b_dir = os.path.join(root, "standby-b")
+    r_dirs = [os.path.join(root, f"replica{i}") for i in range(2)]
+    os.makedirs(a_dir)
+    replicas = [ReplicaServer(d, "127.0.0.1:0") for d in r_dirs]
+    targets = [r.start() for r in replicas]
+
+    violations: list = []
+    acked: dict = {}
+    idems = {i: f"chaos-{plan.seed}-{i}" for i in range(len(specs))}
+    restarts = 0
+    results: dict = {}
+    a_journal = None
+    a_sink = None
+    rt = sites.install(plan, mode="raise")
+    try:
+        # -- reign 1: primary A under faults, killed mid-flight ----------
+        while True:
+            try:
+                sched, a_sink = _boot_replicated(
+                    a_dir, cfg, buckets, chunk_steps, targets, "A"
+                )
+                a_journal = sched.journal
+                _submit_quorum(sched, a_sink, specs, idems, acked,
+                               violations)
+                _check_no_twins(sched, idems, violations)
+                for _ in range(_REIGN1_TICKS):
+                    if acked and all(
+                        sched.jobs[j].terminal for j in acked.values()
+                        if j in sched.jobs
+                    ) and len(acked) == len(specs):
+                        break
+                    sched.tick()
+                    if len(acked) < len(specs) and a_sink.quorum_ok():
+                        _submit_quorum(sched, a_sink, specs, idems,
+                                       acked, violations)
+                break
+            except sites.ChaosCrash:
+                restarts += 1
+                if restarts > len(plan.events) + 2:
+                    violations.append(
+                        f"restart loop: {restarts} restarts for "
+                        f"{len(plan.events)} planned events"
+                    )
+                    break
+
+        # -- the host loss + operator recovery ---------------------------
+        # A is no longer driven (its journal/sink stay live only so the
+        # deposed-primary probe below can attempt a doomed quorum
+        # round). Dead replicas reboot over their surviving disks FIRST:
+        # promotion must see every chain any quorum ever wrote to.
+        _reborn(replicas, targets)
+
+        # -- reign 2: standby B promotes and finishes ---------------------
+        for _attempt in range(len(plan.events) + 3):
+            _reborn(replicas, targets)
+            try:
+                from ..serve.replicate import pull_chain
+
+                pulled = pull_chain(targets, b_dir)
+                if pulled["reachable"] < len(targets):
+                    continue  # a replica is still down; "reboot" again
+                b_sched, b_sink = _boot_replicated(
+                    b_dir, cfg, buckets, chunk_steps, targets, "B"
+                )
+                _submit_quorum(b_sched, b_sink, specs, idems, acked,
+                               violations)
+                _check_no_twins(b_sched, idems, violations)
+                for _ in range(_MAX_TICKS):
+                    if len(acked) == len(specs) and all(
+                        b_sched.jobs[j].terminal
+                        for j in acked.values() if j in b_sched.jobs
+                    ):
+                        break
+                    b_sched.tick()
+                    if len(acked) < len(specs) and b_sink.quorum_ok():
+                        _submit_quorum(b_sched, b_sink, specs, idems,
+                                       acked, violations)
+            except sites.ChaosCrash:
+                restarts += 1
+                continue
+            if len(acked) == len(specs) and all(
+                j in b_sched.jobs and b_sched.jobs[j].terminal
+                for j in acked.values()
+            ):
+                results = {
+                    i: {"state": b_sched.jobs[jid].state,
+                        "result": b_sched.jobs[jid].result}
+                    for i, jid in acked.items() if jid in b_sched.jobs
+                }
+                b_sched.journal.close()
+                b_sink.close()
+                break
+        else:
+            violations.append(
+                f"replication trial did not converge: {len(acked)} of "
+                f"{len(specs)} specs ACKed after every recovery attempt"
+            )
+
+        # -- invariant E: the deposed primary must not still ACK ----------
+        if a_sink is not None and a_journal is not None:
+            try:
+                a_sink.heartbeat()
+                a_journal.append({
+                    "t": "note",
+                    "msg": "doomed write from the deposed primary",
+                })
+            except Exception:  # noqa: BLE001 — any failure IS the fence
+                pass
+            if a_sink.quorum_ok():
+                violations.append(
+                    "invariant E: deposed primary (epoch "
+                    f"{a_sink.epoch}) still reaches its ack quorum "
+                    "after the standby promoted — dual-primary window"
+                )
+            a_sink.close()
+            a_journal.close()
+
+        injected = list(rt.injected)
+    finally:
+        sites.deactivate()
+        for rep in replicas:
+            try:
+                rep.die()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    # -- post-mortem checks over B's surviving state ----------------------
+    rep = run_fsck(b_dir) if os.path.isdir(b_dir) else None
+    if rep is not None:
+        for f in rep.corrupt:
+            violations.append(
+                f"invariant C: fsck {f.kind} at {f.path}: {f.detail}"
+            )
+    for rd in r_dirs:
+        if not (os.path.isdir(b_dir) and os.path.isdir(rd)):
+            continue
+        cmp_rep = run_compare(b_dir, rd)
+        for f in cmp_rep.corrupt:
+            violations.append(
+                f"invariant C: fsck --compare {f.kind}: {f.detail}"
+            )
+    for i in sorted(golden):
+        got = results.get(i)
+        if got is None:
+            if "invariant A" not in " ".join(violations) \
+                    and "did not converge" not in " ".join(violations):
+                violations.append(
+                    f"invariant A: spec {i} never reached a terminal "
+                    "state on the promoted primary"
+                )
+            continue
+        if _canon(got) != _canon(golden[i]):
+            violations.append(
+                f"invariant B: spec {i} result diverged from golden "
+                f"across the failover (got {_canon(got)[:200]}... want "
+                f"{_canon(golden[i])[:200]}...)"
+            )
+    if not keep_dir:
+        shutil.rmtree(root, ignore_errors=True)
+    return TrialResult(plan=plan, violations=violations,
+                       injected=injected, restarts=restarts)
+
+
 # ---- the campaign --------------------------------------------------------
 
 
@@ -410,15 +709,35 @@ def _trial_sites(classes) -> tuple[list, set]:
             names.append(s)
         if cls == "socket":
             socket_only.add(cls)
+    if "replication" in classes:
+        names.extend(REPLICATION_SITES)
     return names, socket_only
+
+
+def _gen_classes(classes) -> tuple:
+    """Classes handed to the plan generator. `replication` implies the
+    replica-kill crashpoint (see REPLICATION_SITES) — the site list
+    already narrows the pool, so widening the class filter here cannot
+    leak serve-side crashpoints into a replication-only campaign."""
+    out = tuple(classes)
+    if "replication" in out and "crashpoint" not in out:
+        out = out + ("crashpoint",)
+    return out
 
 
 def run_trial(plan, cfg=None, specs=DEFAULT_SPECS, golden=None,
               workdir=None, **kw) -> TrialResult:
     """Dispatch one plan to the harness that can reach its sites: plans
-    touching only socket sites go over the wire, everything else runs
-    the in-process serve trial (mixed plans run in-process, where the
-    socket sites are simply never reached and those events stay inert)."""
+    touching any replication site need the primary+replicas+standby
+    topology; plans touching only socket sites go over the wire;
+    everything else runs the in-process serve trial (mixed plans run
+    in-process, where the socket sites are simply never reached and
+    those events stay inert)."""
+    if plan.events and any(
+        e.site in REPLICATION_SITES for e in plan.events
+    ):
+        return run_replication_trial(plan, cfg=cfg, specs=specs,
+                                     golden=golden, workdir=workdir, **kw)
     if plan.events and all(
         sites.SITES.get(e.site) == "socket" for e in plan.events
     ):
@@ -449,9 +768,10 @@ def run_campaign(
         "trials": 0, "violations": [], "fired_events": 0,
         "classes": list(classes), "seed0": seed0,
     }
+    gen_classes = _gen_classes(classes)
     for k in range(n_trials):
         seed = seed0 + k
-        plan = P.generate(seed, classes=classes, sites=site_pool,
+        plan = P.generate(seed, classes=gen_classes, sites=site_pool,
                           max_events=max_events)
         res = run_trial(plan, cfg=cfg, specs=specs, golden=golden,
                         workdir=workdir)

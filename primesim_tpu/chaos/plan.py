@@ -27,6 +27,7 @@ ACTIONS = {
     "socket": ("short_send", "disconnect", "delay", "duplicate"),
     "crashpoint": ("kill",),
     "clock": ("skew",),
+    "replication": ("partition", "delay", "duplicate"),
 }
 
 # recv-side sockets can only lose or delay the reply — tearing or
@@ -114,6 +115,10 @@ def _event_args(rng: random.Random, action: str) -> tuple:
         return (("frac", round(rng.uniform(0.1, 0.9), 3)),)
     if action == "delay":
         return (("s", round(rng.uniform(0.001, 0.02), 4)),)
+    if action == "partition":
+        # how long the replication link stays blacked out before the
+        # partition "heals" and the link may reconnect + resync
+        return (("s", round(rng.uniform(0.05, 0.4), 3)),)
     if action == "skew":
         return (("offset_s", round(rng.uniform(0.5, 30.0), 3)),)
     return ()

@@ -54,6 +54,9 @@ SITES = {
     # clock-skew sites on the lease/heartbeat timers
     "coordinator.clock": "clock",
     "worker.heartbeat.interval": "clock",
+    # replication stream (primary -> replica orders; serve/replicate.py)
+    "replicate.send": "replication",
+    "replica.pre-fsync-ack": "crashpoint",
 }
 
 ENV_PLAN = "PRIMETPU_CHAOS_PLAN"  # path to a FaultPlan JSON file
@@ -254,6 +257,23 @@ def socket_recv(site: str, sock) -> None:
         return
     sock.close()
     raise ConnectionError(f"{site}: injected disconnect before reply")
+
+
+def replication(site: str):
+    """Replication-stream site (primary side, before the order goes on
+    the wire). `delay` stalls in place and is consumed here; `partition`
+    and `duplicate` return the event for the ReplicaLink to enact — a
+    partition must close the link AND suppress reconnection for its
+    window, which only the link's own state can express."""
+    if _RT is None:
+        return None
+    ev = _RT.hit(site)
+    if ev is None:
+        return None
+    if ev.action == "delay":
+        time.sleep(float(ev.arg("s", 0.005)))
+        return None
+    return ev
 
 
 def clock_skew(site: str, value: float) -> float:

@@ -1021,9 +1021,17 @@ def cmd_lint(ns) -> int:
 
 def cmd_fsck(ns) -> int:
     from ..analysis.errors import FsckCorrupt
-    from ..analysis.fsck import render_human, render_json, run_fsck
+    from ..analysis.fsck import (render_human, render_json, run_compare,
+                                 run_fsck)
 
-    res = run_fsck(ns.dir, repair=ns.repair)
+    if ns.compare:
+        res = run_compare(ns.compare[0], ns.compare[1])
+        where = res.root
+    else:
+        if not ns.dir:
+            raise FsckCorrupt("fsck needs DIR (or --compare DIR_A DIR_B)")
+        res = run_fsck(ns.dir, repair=ns.repair)
+        where = ns.dir
     if ns.format == "json":
         print(render_json(res))
     else:
@@ -1032,7 +1040,7 @@ def cmd_fsck(ns) -> int:
         first = res.corrupt[0]
         raise FsckCorrupt(
             f"{len(res.corrupt)} corrupt artifact finding(s) under "
-            f"{ns.dir} (first: {first.path}: {first.detail})",
+            f"{where} (first: {first.path}: {first.detail})",
             path=first.path, n_corrupt=len(res.corrupt),
         )
     return 0
@@ -1101,6 +1109,32 @@ def cmd_serve(ns) -> int:
     rec = _build_recorder(ns)
     if ns.tcp and ns.socket:
         raise SystemExit("--tcp and --socket are mutually exclusive")
+    replicas = [t.strip() for t in (ns.replicas or "").split(",")
+                if t.strip()]
+    if ns.standby_of:
+        # hot standby (DESIGN.md §21): tail the replicas while the
+        # incumbent lives; once it stays dead past the grace window,
+        # adopt the longest replica chain and fall through to serve as
+        # the new primary — whose begin_epoch() fences the old one
+        if not replicas:
+            raise SystemExit("--standby-of requires --replicas")
+        from ..serve.replicate import Standby
+
+        sb = Standby(ns.standby_of, replicas, ns.state_dir,
+                     grace_s=ns.takeover_grace)
+        print(
+            f"serve: standby of {ns.standby_of} "
+            f"(replicas={','.join(replicas)}, "
+            f"grace={ns.takeover_grace}s)",
+            file=sys.stderr,
+        )
+        report = sb.wait_for_takeover()
+        print(
+            f"serve: PROMOTING — adopted chain from {report['source']} "
+            f"(tip seq={report['tip']['seq']}, "
+            f"{report['reachable']} replica(s) reachable)",
+            file=sys.stderr,
+        )
     server = PrimeServer(
         cfg,
         state_dir=ns.state_dir,
@@ -1117,11 +1151,18 @@ def cmd_serve(ns) -> int:
         max_workers=ns.workers,
         lease_ttl_s=ns.lease_ttl,
         quota=TenantQuota.parse(ns.quota) if ns.quota else None,
+        replicas=replicas or None,
+        quorum=ns.quorum,
+        quorum_policy=ns.quorum_policy,
     )
     # bind before the readiness line so `--tcp HOST:0` prints the real
     # kernel-assigned port (tests and scripts scrape this line)
     target = server.bind()
     mode = f"dispatch->{ns.pool_dir}" if ns.pool_dir else "local"
+    if server.repl is not None:
+        mode += (f", replicated x{len(server.repl.links)} "
+                 f"quorum={server.repl.quorum} "
+                 f"epoch={server.repl.epoch}")
     print(
         f"serve: listening on {target} ({mode}, "
         f"recovered={server.recovered['jobs_requeued']} job(s))",
@@ -1153,6 +1194,41 @@ def cmd_serve(ns) -> int:
         file=sys.stderr,
     )
     return rc
+
+
+def cmd_replica(ns) -> int:
+    """Run one journal follower (DESIGN.md §21): a byte-blind segment
+    store behind a `repl.*` listener. Point a primary's `--replicas` at
+    it; a standby promotes from it. SIGTERM stops cleanly — the chain
+    on disk IS the durable state, there is nothing to drain."""
+    import os
+    import signal as _signal
+
+    from ..serve.replicate import ReplicaServer
+
+    if ns.tcp and ns.socket:
+        raise SystemExit("--tcp and --socket are mutually exclusive")
+    server = ReplicaServer(ns.dir, ns.tcp or ns.socket
+                           or os.path.join(ns.dir, "replica.sock"))
+    target = server.bind()
+    tip = server.store.tip()
+    print(
+        f"replica: listening on {target} (dir={ns.dir}, "
+        f"epoch={server.epoch}, tip seq={tip['seq']})",
+        file=sys.stderr,
+    )
+
+    def _stop(signum, frame):
+        server.die()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _stop)
+        _signal.signal(_signal.SIGINT, _stop)
+    except ValueError:
+        pass
+    server.serve_forever()
+    server.shutdown()
+    return 0
 
 
 def cmd_submit(ns) -> int:
@@ -1232,23 +1308,44 @@ def cmd_serve_status(ns) -> int:
         elif ns.metrics:
             sys.stdout.write(cli.metrics())
         elif ns.watch:
+            from ..util.backoff import DecorrelatedJitter
+
             n = 0
+            down_since = None
+            failed_polls = 0
+            jit = DecorrelatedJitter(base=min(ns.interval, 0.5),
+                                     cap=max(ns.interval * 4, 2.0))
             while True:
                 # the client already retried once on connect failure;
                 # a still-dead target prints DOWN and keeps watching
-                # (the daemon may be mid-restart or failing over)
+                # under jittered backoff (the daemon may be mid-restart
+                # or failing over to a standby — a wall of watchers must
+                # not stampede the reborn listener in the same instant)
                 try:
                     line = _watch_line(cli.health())
+                    if down_since is not None:
+                        line += (
+                            f"  [RECOVERED after "
+                            f"{time.monotonic() - down_since:.1f}s "
+                            f"({failed_polls} failed poll(s)) "
+                            f"via {cli.target}]"
+                        )
+                        down_since = None
+                        failed_polls = 0
+                        jit.reset()
                 except (ServeError, OSError) as e:
+                    down_since = down_since or time.monotonic()
+                    failed_polls += 1
                     line = (
                         f"{time.strftime('%H:%M:%S')}  "
-                        f"DOWN {ns.socket} ({type(e).__name__})"
+                        f"DOWN {cli.target} ({type(e).__name__})"
                     )
                 print(line, flush=True)
                 n += 1
                 if ns.count and n >= ns.count:
                     break
-                time.sleep(ns.interval)
+                time.sleep(jit.next_delay() if down_since is not None
+                           else ns.interval)
         else:
             print(json.dumps(cli.health()))
     except KeyboardInterrupt:
@@ -1699,9 +1796,60 @@ def build_parser() -> argparse.ArgumentParser:
              "resubmitted (trace, config) job starts from the deepest "
              "matching cached state instead of step 0",
     )
+    v.add_argument(
+        "--replicas", default="", metavar="TARGET[,TARGET...]",
+        help="replicate the journal to these follower daemons "
+             "(`primetpu replica` targets, host:port or socket paths); "
+             "'' (default) = replication off, bit-exact with today",
+    )
+    v.add_argument(
+        "--quorum", type=int, default=None, metavar="K",
+        help="replica ACKs required per frame (default: majority of "
+             "the N+1 durability domains counting this primary, "
+             "i.e. (N+1)//2 for N replicas)",
+    )
+    v.add_argument(
+        "--quorum-policy", choices=("block", "degrade"), default="block",
+        help="below quorum: block admission with ReplicaQuorumLost + "
+             "retry_after_s (default), or degrade — keep ACKing on "
+             "local fsync while flagging health/metrics",
+    )
+    v.add_argument(
+        "--standby-of", default=None, metavar="TARGET",
+        help="hot standby: tail --replicas while this primary target "
+             "answers; once it stays dead past --takeover-grace, adopt "
+             "the longest replica chain and promote (a fresh fencing "
+             "epoch deposes the old primary)",
+    )
+    v.add_argument(
+        "--takeover-grace", type=float, default=3.0, metavar="SEC",
+        help="--standby-of: how long the primary must stay dead before "
+             "promotion (default 3.0)",
+    )
     _add_fault_flags(v)
     _add_obs_flags(v)
     v.set_defaults(fn=cmd_serve)
+
+    rp = sub.add_parser(
+        "replica",
+        help="run one journal follower for replicated serving "
+             "(DESIGN.md §21): byte-identical segment chain, fsynced "
+             "before ACK, fencing-epoch aware",
+    )
+    rp.add_argument(
+        "--dir", required=True, metavar="DIR",
+        help="this follower's journal directory (its durability domain)",
+    )
+    rp.add_argument(
+        "--socket", default=None, metavar="PATH",
+        help="unix socket to listen on (default: DIR/replica.sock)",
+    )
+    rp.add_argument(
+        "--tcp", default=None, metavar="HOST:PORT",
+        help="listen on TCP instead (port 0 = kernel-assigned; the "
+             "readiness line prints the real one)",
+    )
+    rp.set_defaults(fn=cmd_replica)
 
     b = sub.add_parser(
         "submit",
@@ -1803,7 +1951,14 @@ def build_parser() -> argparse.ArgumentParser:
              "checkpoints, warm cache) under a directory; exit 2 with "
              "structured JSON on corruption",
     )
-    fk.add_argument("dir", metavar="DIR", help="artifact root to verify")
+    fk.add_argument("dir", metavar="DIR", nargs="?",
+                    help="artifact root to verify")
+    fk.add_argument(
+        "--compare", nargs=2, metavar=("DIR_A", "DIR_B"),
+        help="instead of verifying one root, check two journal chains "
+             "(primary vs replica) frame-for-frame up to the shorter "
+             "one's durable point; divergence exits 2",
+    )
     fk.add_argument(
         "--repair", choices=("none", "quarantine"), default="none",
         help="quarantine moves (never deletes) corrupt/orphaned files "
@@ -1832,7 +1987,10 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument(
         "--classes", default="durable,crashpoint",
         help="comma list of fault classes to draw from: durable, "
-             "crashpoint, socket (default durable,crashpoint)",
+             "crashpoint, socket, replication (default "
+             "durable,crashpoint; replication runs the primary+"
+             "replicas+standby failover trial and implies replica-kill "
+             "crashpoints)",
     )
     ch.add_argument(
         "--max-events", type=int, default=3,

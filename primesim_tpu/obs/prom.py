@@ -55,7 +55,7 @@ class _Doc:
 
 
 def render_prometheus(sched, journal=None, draining=False,
-                      recovered=None, quota=None) -> str:
+                      recovered=None, quota=None, repl=None) -> str:
     """Render the daemon's scrape payload from a live Scheduler (and
     optionally its JobJournal + the server's recovery/drain state)."""
     s = sched.stats()
@@ -139,6 +139,35 @@ def render_prometheus(sched, journal=None, draining=False,
             d.histogram("primetpu_journal_fsync_seconds",
                         "Wall time of each journal write+flush+fsync.",
                         fsync)
+
+    if repl is not None:
+        rs = repl.status()
+        d.metric("primetpu_replication_links", "gauge",
+                 "Replica links by connection state.",
+                 [({"state": "connected"},
+                   sum(1 for r in rs["replicas"] if r["connected"])),
+                  ({"state": "configured"}, len(rs["replicas"]))])
+        d.metric("primetpu_replication_quorum_ok", "gauge",
+                 "1 while the last quorum round reached the configured "
+                 "replica-ack quorum (0 = degraded or blocking).",
+                 [(None, 1 if rs["quorum_ok"] else 0)])
+        d.metric("primetpu_replication_epoch", "gauge",
+                 "Fencing epoch of this primary's reign.",
+                 [(None, rs["epoch"])])
+        d.metric("primetpu_replication_fenced", "gauge",
+                 "1 once a higher epoch deposed this primary "
+                 "(it stops ACKing and exits 75).",
+                 [(None, 1 if rs["fenced"] else 0)])
+        d.metric("primetpu_replication_degraded_acks_total", "counter",
+                 "Appends ACKed on local fsync only while below quorum "
+                 "(--quorum-policy degrade).",
+                 [(None, rs["degraded_acks"])])
+        d.metric("primetpu_replication_quorum_losses_total", "counter",
+                 "Quorum rounds that fell short of the required "
+                 "replica acks.", [(None, rs["quorum_losses"])])
+        d.metric("primetpu_replication_resyncs_total", "counter",
+                 "Follower catch-up resyncs pushed by this primary.",
+                 [(None, rs["resyncs"])])
 
     if recovered:
         d.metric("primetpu_recovered_jobs", "gauge",

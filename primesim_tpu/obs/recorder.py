@@ -124,6 +124,15 @@ class Recorder:
                 "pool", kind, {k: str(v) for k, v in args.items()}
             )
 
+    def repl_event(self, kind, **args) -> None:
+        """Replication instant (epoch, resync, fenced, quorum-lost) on
+        the ``repl`` track — the TIMELINE's evidence of every fencing
+        and catch-up decision the primary's sink made."""
+        if self.trace is not None:
+            self.trace.instant(
+                "repl", kind, {k: str(v) for k, v in args.items()}
+            )
+
     # ---- output ----------------------------------------------------------
 
     def timeline_summary(self):

@@ -44,7 +44,14 @@ class ServeClient:
     def __init__(self, target: str, timeout_s: float = 30.0,
                  connect_timeout_s: float = 5.0,
                  max_reconnects: int = 1, rng=None):
-        self.target = str(target)
+        # `target` may be a comma-separated failover list ("primary,
+        # standby"): a connect-phase failure rotates to the next entry,
+        # so a watched/submitting client rides out a promotion instead
+        # of dying with the old primary
+        self.targets = [t.strip() for t in str(target).split(",")
+                        if t.strip()] or [str(target)]
+        self._ti = 0
+        self.target = self.targets[0]
         self.socket_path = self.target  # legacy alias (pre-TCP callers)
         self.timeout_s = float(timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
@@ -62,7 +69,9 @@ class ServeClient:
                 reply = self._request(req, timeout_s)
                 break
             except ServeUnavailable:
-                # connect never completed: always safe to retry
+                # connect never completed: always safe to retry — on
+                # the NEXT target of the failover list when one exists
+                self._rotate()
                 if attempt >= self.max_reconnects:
                     raise
             except (ConnectionError, OSError):
@@ -71,12 +80,18 @@ class ServeClient:
                 # read-only request may be replayed
                 if not idempotent or attempt >= self.max_reconnects:
                     raise
+                self._rotate()
             attempt += 1
             self.reconnects += 1
             time.sleep(jitter.next_delay())
         if not reply.get("ok", False):
             raise ServeError(reply)
         return reply
+
+    def _rotate(self) -> None:
+        if len(self.targets) > 1:
+            self._ti = (self._ti + 1) % len(self.targets)
+            self.target = self.targets[self._ti]
 
     def _request(self, req: dict, timeout_s: float | None) -> dict:
         return request(

@@ -166,6 +166,10 @@ class JobJournal:
         # on the flight-recorder timeline
         self.fsync_hist = Histogram()
         self.obs = None
+        # optional replication sink (serve/replicate.py): called AFTER
+        # the local fsync with the exact raw bytes on disk, so follower
+        # chains stay byte-identical to this one
+        self.sink = None
 
     # ---- segment bookkeeping ---------------------------------------------
 
@@ -274,6 +278,10 @@ class JobJournal:
         self._fsync_dir()
         self._open_active(seq=self._active_seq + 1, prev_crc=self._last_crc)
         self.segments_rolled += 1
+        if self.sink is not None:
+            header_lines = _scan_lines(self.path)
+            if header_lines:
+                self.sink.on_roll(self._active_seq, header_lines[0])
         if (
             self.compactor is not None
             and len(self._rolled_segments()) >= self.compact_segments
@@ -295,6 +303,7 @@ class JobJournal:
             self._roll()
         t0 = time.perf_counter()
         line = _frame(rec)
+        prev_crc = self._last_crc
         chaos.durable("journal.append", f=self._f, data=line + "\n")
         self._f.write(line + "\n")
         self._f.flush()
@@ -306,6 +315,11 @@ class JobJournal:
         self.fsync_hist.observe(dt)
         if self.obs is not None:
             self.obs.fsync_event(dt)
+        if self.sink is not None:
+            # locally durable first, then the wire: the sink ships the
+            # raw line and books the quorum; the SERVER decides whether
+            # an under-quorum frame may still be ACKed (quorum policy)
+            self.sink.on_append(line, self._active_seq, prev_crc)
 
     def accept(self, job) -> None:
         self.append({"t": "accept", "job": job.accept_record()})
@@ -356,6 +370,10 @@ class JobJournal:
                 pass
         self._fsync_dir()
         self.compactions += 1
+        if self.sink is not None:
+            # history was rewritten under the followers: resync them
+            # from the new BASE before the next per-frame order
+            self.sink.on_base()
         self.append({
             "t": "note",
             "msg": f"compacted: {len(records)} records -> {len(kept)}",

@@ -26,7 +26,15 @@ Verbs:
 
 Backpressure: a submit against a full queue gets
 `{"ok": false, "retry_after_s": <float>, "error": {...}}` — the client
-is expected to back off, not spin.
+is expected to back off, not spin. A below-quorum replicated primary
+(DESIGN.md §21) answers the same shape with a `ReplicaQuorumLost`
+error; a fenced one adds `"fenced": true`.
+
+The journal-replication verbs (`repl.hello/append/roll/seg/reset/
+fetch/status` — serve/replicate.py) ride this same framing over a
+PERSISTENT connection: the primary's sink holds one socket per replica
+and exchanges one order/ack line pair per journal mutation, instead of
+`request()`'s connect-per-call.
 """
 
 from __future__ import annotations
