@@ -515,7 +515,7 @@ def run_replication_trial(
     2. A's HOST is lost mid-flight (we stop driving it but keep its
        sink alive for the dual-primary probe); dead replicas are
        rebooted over their surviving disks;
-    3. standby B promotes: pulls the longest replica chain, opens a
+    3. standby B promotes: pulls the highest-epoch replica chain, opens a
        higher fencing epoch, re-admits anything never ACKed, finishes
        every job;
     4. the deposed A then attempts a quorum round — if it can still

@@ -1114,7 +1114,7 @@ def cmd_serve(ns) -> int:
     if ns.standby_of:
         # hot standby (DESIGN.md §21): tail the replicas while the
         # incumbent lives; once it stays dead past the grace window,
-        # adopt the longest replica chain and fall through to serve as
+        # adopt the highest-epoch replica chain and fall through to serve
         # the new primary — whose begin_epoch() fences the old one
         if not replicas:
             raise SystemExit("--standby-of requires --replicas")
@@ -1804,9 +1804,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     v.add_argument(
         "--quorum", type=int, default=None, metavar="K",
-        help="replica ACKs required per frame (default: majority of "
-             "the N+1 durability domains counting this primary, "
-             "i.e. (N+1)//2 for N replicas)",
+        help="replica ACKs required per frame (default: strict "
+             "majority of the N replicas, N//2+1; any explicit K must "
+             "satisfy 2K > N or quorums stop intersecting and fencing "
+             "cannot be guaranteed)",
     )
     v.add_argument(
         "--quorum-policy", choices=("block", "degrade"), default="block",
@@ -1818,7 +1819,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--standby-of", default=None, metavar="TARGET",
         help="hot standby: tail --replicas while this primary target "
              "answers; once it stays dead past --takeover-grace, adopt "
-             "the longest replica chain and promote (a fresh fencing "
+             "the highest-epoch replica chain and promote (a fresh fencing "
              "epoch deposes the old primary)",
     )
     v.add_argument(

@@ -52,7 +52,9 @@ are ignored, so compaction is crash-safe at every instant.
 Record types (`t` field): `accept` (the Job accept_record), `state`
 (job_id + new state + detail/result), `drain` (clean shutdown marker),
 `note` (operator annotations), `seg` (segment header, filtered out of
-`replay()` results), plus the pool ledger types (units.py).
+`replay()` results), `epoch` (fencing epoch, replicate.py — preserved
+across compaction by `compact()` itself, since no domain compactor
+knows about it), plus the pool ledger types (units.py).
 """
 
 from __future__ import annotations
@@ -358,6 +360,24 @@ class JobJournal:
             raise RuntimeError("journal has no compactor configured")
         records, _ = self.replay()
         kept = list(self.compactor(records))
+        # the fencing epoch (replicate.py) must survive compaction even
+        # though domain compactors only know their own record types: a
+        # BASE that propagated to every replica is the ONLY copy of the
+        # chain left, and losing the epoch frame would let epochs
+        # regress after a restart — a stale primary could rejoin
+        # un-fenced, or a new reign could reuse a fenced epoch number.
+        # Re-emit the highest epoch frame first, where a reign puts it.
+        fence = None
+        for rec in records:
+            if rec.get("t") == "epoch" and (
+                fence is None
+                or int(rec.get("epoch", 0)) > int(fence.get("epoch", 0))
+            ):
+                fence = rec
+        if fence is not None and not any(
+            r.get("t") == "epoch" for r in kept
+        ):
+            kept.insert(0, fence)
         stale = self._rolled_segments()
         self._f.close()
         self._open_active(

@@ -13,15 +13,20 @@ hole with classic primary-backup quorum commit:
   points) and `primetpu fsck --compare` can hold the two directories to
   frame-for-frame agreement;
 - `append()` reports quorum only after K replicas ACKed an fsync of the
-  frame (default K = majority of the N+1 durability domains counting
-  the primary, i.e. `(N+1)//2` replica acks). The SERVER only ACKs a
+  frame (default K = a strict majority of the N replicas, `N//2 + 1`;
+  any explicit `--quorum` must satisfy `2K > N`, the intersection
+  property the fencing argument stands on). The SERVER only ACKs a
   submit whose accept record reached quorum — ACKed now means "on K+1
   disks", not "on one disk";
 - a follower that was down catches up on reconnect: the primary reads
-  its tip (active seq + last chained CRC) and re-ships the segment
-  range past it; a follower behind a compaction BASE is resynced from
-  the BASE (its stale chain — including any un-quorumed tail inherited
-  from a deposed primary — is discarded wholesale);
+  its tip (active seq + record count + last chained CRC), verifies the
+  tip CRC against its own chain at the identical position (seq ranges
+  alone cannot prove a byte-prefix once a diverged tail has crossed a
+  roll boundary), and re-ships the segment range past it; a follower
+  behind a compaction BASE — or one whose tip CRC diverges — is reset
+  and resynced from the BASE (its stale chain, including any
+  un-quorumed tail inherited from a deposed primary, is discarded
+  wholesale);
 - FENCING: each primary reign opens by appending a monotonically
   increasing `{"t": "epoch"}` frame and announcing the epoch on every
   link. Replicas remember the highest epoch they ever ACKed and refuse
@@ -71,7 +76,7 @@ from .protocol import (
 #:   repl.seg    {epoch, seq, lines, active}  -> wholesale segment write
 #:   repl.reset  {epoch}                      -> wipe chain (pre-resync)
 #:   repl.fetch  {from_seq}                   -> {segments} (standby pull)
-#:   repl.status {}                           -> {epoch, tip}
+#:   repl.status {}                           -> {epoch, chain_epoch, tip}
 REPL_VERBS = (
     "repl.hello", "repl.append", "repl.roll", "repl.seg",
     "repl.reset", "repl.fetch", "repl.status",
@@ -289,7 +294,15 @@ class ReplicaServer:
         verb = req.get("verb")
         try:
             if verb == "repl.status":
+                # chain_epoch is the highest epoch frame ON DISK —
+                # distinct from the fence (self.epoch), which a hello
+                # can raise without shipping any chain bytes. Promotion
+                # orders candidate chains by chain_epoch: a reign's
+                # quorum-ACKed history always starts with its epoch
+                # frame, so a deposed primary's stale (possibly longer)
+                # tail can never outrank the newest reign's chain.
                 return {"ok": True, "epoch": self.epoch,
+                        "chain_epoch": self._scan_epoch(),
                         "tip": self.store.tip(), "dir": self.store.dir}
             if verb == "repl.fetch":
                 out = self.store.fetch(int(req.get("from_seq", 0)))
@@ -511,10 +524,13 @@ class ReplicationSink:
     journal calls `on_append`/`on_roll`/`on_base` from its own write
     path, AFTER the local fsync (local durability first, then the wire).
 
-    `quorum` counts REPLICA acks; the default `(N+1)//2` makes
-    {primary + ackers} a majority of the N+1 durability domains, which
-    is what the fencing safety argument needs: any two quorums share a
-    replica, so a new epoch's quorum always intersects the old one."""
+    `quorum` counts REPLICA acks; the default `N//2 + 1` is a strict
+    majority of the replicas, and any explicit quorum must satisfy
+    `2K > N` — the intersection property the fencing safety argument
+    stands on: two K-sized ack sets out of N replicas are guaranteed to
+    share a replica ONLY when 2K > N (K=(N+1)//2 fails this for even N,
+    e.g. two disjoint single-replica "quorums" at N=2), and that shared
+    replica is the one that fences the deposed primary."""
 
     def __init__(self, journal: JobJournal, replicas: list[str],
                  quorum: int | None = None, policy: str = "block",
@@ -527,11 +543,19 @@ class ReplicationSink:
         self.journal = journal
         self.links = [ReplicaLink(t, rng=rng) for t in replicas]
         n = len(self.links)
-        self.quorum = int(quorum) if quorum else (n + 1) // 2
+        self.quorum = int(quorum) if quorum else n // 2 + 1
         if not 1 <= self.quorum <= n:
             raise ReplicaQuorumLost(
                 f"--quorum {self.quorum} out of range 1..{n} "
                 f"for {n} replica(s)"
+            )
+        if 2 * self.quorum <= n:
+            raise ReplicaQuorumLost(
+                f"--quorum {self.quorum} of {n} replica(s) does not "
+                f"guarantee quorum intersection (needs 2K > N, i.e. "
+                f">= {n // 2 + 1}): two disjoint ack sets could each "
+                "reach quorum and a promoted standby would never fence "
+                "the old primary"
             )
         self.policy = policy
         self.retry_after_s = float(retry_after_s)
@@ -569,11 +593,35 @@ class ReplicationSink:
 
     # -- per-link sync -----------------------------------------------------
 
+    def _crc_at(self, seq: int, records: int) -> int | None:
+        """Chained line CRC of OUR segment `seq` after `records` records
+        — the value a follower whose chain is a byte-prefix of ours
+        must report as its tip crc. None when we hold no such position
+        (no segment with that seq, or fewer records than asked)."""
+        for s, path, _ in self._chain():
+            if s != int(seq):
+                continue
+            lines = _scan_lines(path)
+            n = 0
+            crc = 0
+            for i, line in enumerate(lines):
+                rec = _unframe(line)
+                if rec is None:
+                    break  # torn tail: nothing past the last whole frame
+                if not (i == 0 and rec.get("t") == "seg"):
+                    if n == int(records):
+                        break
+                    n += 1
+                crc = _line_crc(line)
+            return crc if n == int(records) else None
+        return None
+
     def _sync_link(self, link: ReplicaLink) -> bool:
         """Bring one replica to our exact chain: hello for its tip, then
         re-ship whole segments from where it diverges (or reset + ship
-        everything from the newest BASE when it sits behind one). Raw
-        bytes only — the replica ends byte-identical or not at all."""
+        everything from the newest BASE when the tip is behind one or
+        its bytes diverge from ours). Raw bytes only — the replica ends
+        byte-identical or not at all."""
         hello = link.call({"verb": "repl.hello", "epoch": self.epoch})
         if hello is None:
             return False
@@ -587,11 +635,23 @@ class ReplicationSink:
             return True
         base = self._base_seq()
         from_seq = int(tip.get("seq", -1))
-        if from_seq < base or from_seq > chain[-1][0]:
-            # behind a compaction BASE (or ahead of us entirely): the
-            # follower's history is not a prefix of ours — discard and
-            # resync from the BASE. This is also where a deposed
-            # primary's un-quorumed tail dies on rejoin.
+        diverged = False
+        if base <= from_seq <= chain[-1][0]:
+            # the seq range alone cannot prove the follower's chain is a
+            # prefix of ours: a deposed primary whose un-quorumed tail
+            # crossed a roll boundary has rolled segments at the SAME
+            # seqs with different bytes. Hold its tip crc to our chain
+            # at the identical (segment, record) position — the tip
+            # line's crc chains over the whole prefix (each roll header
+            # back-links the previous segment's last line), so a match
+            # certifies the prefix and a mismatch forces a full resync.
+            want = self._crc_at(from_seq, int(tip.get("records", 0)))
+            diverged = want is None or want != int(tip.get("crc", 0))
+        if diverged or from_seq < base or from_seq > chain[-1][0]:
+            # behind a compaction BASE, ahead of us entirely, or
+            # byte-diverged: the follower's history is not a prefix of
+            # ours — discard and resync from the BASE. This is also
+            # where a deposed primary's un-quorumed tail dies on rejoin.
             if link.call({"verb": "repl.reset",
                           "epoch": self.epoch}) is None:
                 return False
@@ -632,7 +692,15 @@ class ReplicationSink:
         for link in self.links:
             if self.fenced:
                 break
-            if link.needs_sync and not self._sync_link(link):
+            if link.needs_sync:
+                # the sync ships our on-disk chain, which ALREADY holds
+                # this order's effect (the journal seams run after the
+                # local write) — the per-frame order would only bounce
+                # off the replica's position check and buy a second
+                # wholesale resync. A successful sync IS the ack.
+                if self._sync_link(link):
+                    acks += 1
+                    link.acks += 1
                 continue
             r = link.call(req)
             if r is None:
@@ -785,11 +853,18 @@ def _repl_call(target: str, req: dict, timeout_s: float = 5.0) -> dict:
 
 
 def pull_chain(replicas: list[str], dest_dir: str) -> dict:
-    """Copy the LONGEST reachable replica chain into `dest_dir`
-    verbatim (wiping whatever chain sat there — a stale standby tail is
-    exactly the history a promotion must discard). Returns
-    {source, epoch, tip, reachable}; raises ReplicaQuorumLost when no
-    replica answers."""
+    """Copy the best reachable replica chain into `dest_dir` verbatim
+    (wiping whatever chain sat there — a stale standby tail is exactly
+    the history a promotion must discard). Candidates are ordered by
+    (chain epoch, seq, records): EPOCH FIRST, because a deposed
+    primary's replica-local un-quorumed tail can be LONGER than the new
+    reign's quorum-ACKed chain — adopting it by length alone would
+    silently discard quorum-ACKed jobs (invariant A). Every reign's
+    chain opens with its epoch frame, so the highest chain epoch marks
+    the replica that holds the newest reign's history; length only
+    breaks ties within one reign, where chains are linear prefixes of
+    each other. Returns {source, epoch, tip, reachable}; raises
+    ReplicaQuorumLost when no replica answers."""
     best = None
     reachable = 0
     for t in replicas:
@@ -799,7 +874,8 @@ def pull_chain(replicas: list[str], dest_dir: str) -> dict:
             continue
         reachable += 1
         tip = st.get("tip") or {}
-        key = (int(tip.get("seq", -1)), int(tip.get("records", 0)))
+        key = (int(st.get("chain_epoch", 0)),
+               int(tip.get("seq", -1)), int(tip.get("records", 0)))
         if best is None or key > best[0]:
             best = (key, t, st)
     if best is None:
@@ -821,7 +897,7 @@ class Standby:
     """`primetpu serve --standby-of PRIMARY`: tail a follower while the
     primary lives, promote when it stays dead past the grace window.
 
-    Promotion = pull the longest reachable replica chain into our own
+    Promotion = pull the best (highest-epoch) reachable replica chain into our own
     state dir, then start serving with a fresh fencing epoch — the
     epoch frame's quorum commit is what actually deposes the old
     primary; until it lands, the standby is not a primary."""
@@ -836,8 +912,10 @@ class Standby:
         self.poll_s = float(poll_s)
         self.rng = rng
         n = len(self.replicas)
+        # same 2K > N majority as the sink's quorum: a minority-
+        # partition standby must not elect itself
         self.min_reachable = (
-            int(min_reachable) if min_reachable else (n + 1) // 2
+            int(min_reachable) if min_reachable else n // 2 + 1
         )
         self.last_sync: dict | None = None
 
@@ -878,7 +956,7 @@ class Standby:
     def promote_pull(self) -> dict:
         """The final pre-promotion pull: require a quorum's worth of
         reachable replicas (a minority view must not elect itself), then
-        adopt the longest chain."""
+        adopt the highest-epoch chain."""
         report = pull_chain(self.replicas, self.state_dir)
         if report["reachable"] < self.min_reachable:
             raise ReplicaQuorumLost(

@@ -129,15 +129,16 @@ def test_follower_catches_up_across_two_rolls_chain_identical(tmp_path):
     """A follower that is DOWN while the primary rolls the active
     segment twice must, on rebirth, converge to a byte-identical chain
     via the segment-range resync — not just a compatible one."""
+    # 3 replicas: the majority quorum (2) survives one follower's death
     j, sink, replicas, targets, pdir = _replicated_journal(
-        tmp_path, segment_records=3
+        tmp_path, n_replicas=3, segment_records=3
     )
     j.append({"t": "accept", "job_id": "j0", "spec": {}})
     replicas[0].die()
     time.sleep(0.05)
     for i in range(1, 9):  # rolls the active segment at least twice
         j.append({"t": "accept", "job_id": f"j{i}", "spec": {}})
-    # quorum 1 of 2: the surviving follower kept the primary ACKing
+    # quorum 2 of 3: the surviving followers kept the primary ACKing
     assert sink.quorum_ok()
     assert _chain_bytes(replicas[0].store.dir) != _chain_bytes(pdir)
 
@@ -152,8 +153,35 @@ def test_follower_catches_up_across_two_rolls_chain_identical(tmp_path):
 
     want = _chain_bytes(pdir)
     assert _chain_bytes(reborn.store.dir) == want
-    assert _chain_bytes(replicas[1].store.dir) == want
+    for r in replicas[1:]:
+        assert _chain_bytes(r.store.dir) == want
     assert sink.resyncs >= 1
+    sink.close()
+    j.close()
+
+
+def test_recovered_replica_resyncs_once_per_append(tmp_path):
+    """An append to a freshly recovered (needs_sync) replica costs ONE
+    wholesale sync — the sync ships the active segment already holding
+    the frame, so replaying the per-frame order would only bounce off
+    the position check and buy a second full resync."""
+    j, sink, replicas, targets, pdir = _replicated_journal(
+        tmp_path, n_replicas=3
+    )
+    replicas[0].die()
+    time.sleep(0.05)
+    link = sink.links[0]
+    link._drop()  # the failure detector's verdict, made deterministic
+    j.append({"t": "accept", "job_id": "j0", "spec": {}})  # missed by r0
+    reborn = ReplicaServer(replicas[0].store.dir, "127.0.0.1:0")
+    link.target = reborn.start()
+    link.retry_at = 0.0
+    link.blackout_until = 0.0
+    before = sink.resyncs
+    j.append({"t": "accept", "job_id": "j1", "spec": {}})
+    assert sink.resyncs == before + 1  # exactly one sync, counted as ack
+    assert sink.quorum_ok()
+    assert _chain_bytes(reborn.store.dir) == _chain_bytes(pdir)
     sink.close()
     j.close()
 
@@ -227,6 +255,34 @@ def test_quorum_validation_rejects_out_of_range(tmp_path):
     j.close()
 
 
+def test_quorum_default_is_strict_majority_and_2k_gt_n_enforced(tmp_path):
+    """Quorum intersection needs 2K > N. The old (N+1)//2 default gave
+    K=1 for N=2 — two DISJOINT single-replica 'quorums', so a promoted
+    standby's epoch frame could commit via one replica while the
+    deposed primary kept ACKing via the other (split brain). Default is
+    now a strict majority, and an explicit non-intersecting K is
+    rejected at construction."""
+    pdir = str(tmp_path / "p")
+    os.makedirs(pdir)
+    j = JobJournal(pdir)
+    for n, want in ((1, 1), (2, 2), (3, 2), (4, 3), (5, 3)):
+        sink = ReplicationSink(j, [f"r{i}:1" for i in range(n)])
+        assert sink.quorum == want == n // 2 + 1
+        assert 2 * sink.quorum > n
+        sink.close()
+    for n, k in ((2, 1), (4, 2), (5, 2)):
+        with pytest.raises(ReplicaQuorumLost, match="intersection"):
+            ReplicationSink(j, [f"r{i}:1" for i in range(n)], quorum=k)
+    j.close()
+
+
+def test_standby_min_reachable_defaults_to_majority(tmp_path):
+    sb = Standby("nope.sock", ["a:1", "b:2"], str(tmp_path / "s"))
+    assert sb.min_reachable == 2  # N=2: a 1-replica minority view
+    sb3 = Standby("nope.sock", ["a:1", "b:2", "c:3"], str(tmp_path / "s3"))
+    assert sb3.min_reachable == 2
+
+
 # ---- fencing / promotion -------------------------------------------------
 
 
@@ -236,7 +292,7 @@ def test_standby_promotion_fences_old_primary(tmp_path):
         j.append({"t": "accept", "job_id": f"j{i}", "spec": {}})
     assert a_sink.epoch == 1
 
-    # standby B: adopt the longest replica chain, open epoch 2
+    # standby B: adopt the newest-reign replica chain, open epoch 2
     b_dir = str(tmp_path / "standby")
     report = pull_chain(targets, b_dir)
     assert report["reachable"] == 2
@@ -315,6 +371,131 @@ def test_standby_requires_reachable_quorum_to_promote(tmp_path):
     with pytest.raises(ReplicaQuorumLost):
         sb.promote_pull()
     a_sink.close()
+    j.close()
+
+
+def test_pull_chain_prefers_newest_epoch_over_longer_stale_tail(tmp_path):
+    """Invariant A at promotion time: a deposed primary's un-quorumed
+    tail can sit on ONE replica and be LONGER than the new reign's
+    quorum-ACKed chain. A later promotion must adopt the chain holding
+    the newest epoch frame — never the stale tail, however long."""
+    # reign 1 (epoch 1): primary A replicates to r0 only
+    r0 = ReplicaServer(str(tmp_path / "r0"), "127.0.0.1:0")
+    t0 = r0.start()
+    a_dir = str(tmp_path / "a")
+    os.makedirs(a_dir)
+    a_j = JobJournal(a_dir)
+    a_sink = ReplicationSink(a_j, [t0], node="A")
+    a_j.sink = a_sink
+    a_sink.begin_epoch()
+    a_j.append({"t": "accept", "job_id": "j0", "spec": {}})
+
+    # reign 2 (epoch 2): standby B promotes off r0's chain but its own
+    # reign replicates to r1 only (the partition's other half) — r1
+    # carries epoch 2 and the quorum-ACKed job of the new reign
+    r1 = ReplicaServer(str(tmp_path / "r1"), "127.0.0.1:0")
+    t1 = r1.start()
+    b_dir = str(tmp_path / "b")
+    pull_chain([t0], b_dir)
+    b_j = JobJournal(b_dir)
+    b_sink = ReplicationSink(b_j, [t1], node="B")
+    b_j.sink = b_sink
+    assert b_sink.begin_epoch() == 2
+    b_j.append({"t": "accept", "job_id": "acked-by-reign-2", "spec": {}})
+    assert b_sink.quorum_ok()
+
+    # the partitioned A keeps shipping its reign-1 tail to r0 — r0
+    # never hears epoch 2, so nothing fences these, and r0's chain
+    # grows LONGER than r1's while staying on the deposed epoch
+    for i in range(8):
+        a_j.append({"t": "accept", "job_id": f"stale{i}", "spec": {}})
+
+    # r0's chain is longer (by records) than r1's — by tip alone the
+    # stale chain would win and the quorum-ACKed job would vanish
+    assert r0.store.tip()["records"] > r1.store.tip()["records"]
+    report = pull_chain([t0, t1], str(tmp_path / "c"))
+    assert report["source"] == t1
+    adopted = "".join(_chain_bytes(str(tmp_path / "c")).values())
+    assert "acked-by-reign-2" in adopted
+    a_sink.close()
+    a_j.close()
+    b_sink.close()
+    b_j.close()
+
+
+def test_compaction_preserves_fencing_epoch(tmp_path):
+    """serve_compactor only knows accept/state/drain — but a compaction
+    BASE propagates to every replica and becomes the ONLY copy of the
+    chain, so compact() itself must re-emit the newest epoch frame. A
+    replica restarted over a compacted chain must still recover the
+    fence (epochs never regress to 0)."""
+    from primesim_tpu.serve.journal import fold_records
+    from primesim_tpu.serve.replicate import max_epoch
+
+    j, sink, replicas, targets, pdir = _replicated_journal(tmp_path)
+    for i in range(6):
+        j.append(_accept_rec(i))
+        j.append({"t": "state", "job_id": f"j{i}", "state": "DONE"})
+    assert sink.epoch == 1
+    j.compact()
+    records, _ = j.replay()
+    assert max_epoch(records) == 1  # survived the primary's own BASE
+    # the fold is untouched by the preserved frame
+    jobs, _clean = fold_records(records)
+    assert len(jobs) == 6
+
+    # a replica reborn over the compacted chain recovers the fence from
+    # disk: the deposed reign (epoch 0 < 1) stays fenced after restart
+    reborn = ReplicaServer(replicas[0].store.dir, "127.0.0.1:0")
+    assert reborn.epoch == 1
+    assert reborn.handle({"verb": "repl.hello", "epoch": 0})["fenced"]
+    sink.close()
+    j.close()
+
+
+def test_diverged_rolled_prefix_forces_full_resync(tmp_path):
+    """Seq ranges alone cannot prove a follower's chain is a prefix:
+    a deposed primary whose un-quorumed tail crossed a roll boundary
+    leaves rolled segments at the SAME seqs with different bytes. The
+    tip-CRC check must catch this and fall back to reset + full resync
+    — otherwise the follower counts toward quorum while its rolled
+    prefix silently diverges (breaking fsck --compare invariant C)."""
+    # the deposed reign's chain: same segment layout, different history
+    stale_dir = str(tmp_path / "stale")
+    os.makedirs(stale_dir)
+    stale = JobJournal(stale_dir, segment_records=3)
+    for i in range(7):  # crosses two roll boundaries
+        stale.append({"t": "accept", "job_id": f"stale{i}", "spec": {}})
+    stale.close()
+    # the follower inherited that chain verbatim (it was the deposed
+    # primary's only reachable replica)
+    r_dir = str(tmp_path / "replica")
+    shutil.copytree(stale_dir, r_dir)
+    rep = ReplicaServer(r_dir, "127.0.0.1:0")
+    target = rep.start()
+
+    # the new reign's chain, built BEFORE the link comes up so its
+    # first sync sees the same seq range the follower reports: same
+    # segment layout (same record cadence), entirely different bytes
+    pdir = str(tmp_path / "primary")
+    os.makedirs(pdir)
+    j = JobJournal(pdir, segment_records=3)
+    j.append({"t": "epoch", "epoch": 2, "node": "B"})
+    for i in range(6):
+        j.append({"t": "accept", "job_id": f"new{i}", "spec": {}})
+    sink = ReplicationSink(j, [target], node="B")
+    j.sink = sink
+    sink.epoch = 2
+    # the range check alone would pass (follower tip seq sits inside
+    # our chain); only the tip-CRC check notices the divergence
+    sink.heartbeat()
+    assert sink.quorum_ok()
+    want = _chain_bytes(pdir)
+    got = _chain_bytes(rep.store.dir)
+    assert got == want  # EVERY segment, rolled prefix included
+    assert "stale" not in "".join(got.values())
+    assert run_compare(pdir, r_dir).clean
+    sink.close()
     j.close()
 
 
