@@ -22,7 +22,11 @@ sweep_fork_speedup measurement (a 16-seed chaos campaign with the
 shared prefix forked once vs simulated 16 times, DESIGN.md §16).
 `PRIMETPU_BENCH_UNIFIED=0` skips the unified_serve_speedup measurement
 (the same job batch through the TCP front-end dispatching to 3 vs 1
-real pool workers, DESIGN.md §18).
+real pool workers, DESIGN.md §18). `PRIMETPU_BENCH_SHARD=0` skips the
+fleet_shard_scaling measurement (the batch-8 rung-1 fleet sharded over
+1/4/8 devices, shard x vmap — DESIGN.md §22; also skipped with a null
+metric when fewer than 8 devices are visible — CI pins
+XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh).
 
 Rung-3 knobs: `PRIMETPU_BENCH_RUNG3=0` skips the rung-3 measurement;
 `PRIMETPU_BENCH_RUNG3_FLOOR=<mips>` makes the regression gate HARD
@@ -83,16 +87,17 @@ def _measure(cfg, trace, chunk: int, runs: int = 3):
     return eng, min(walls), walls
 
 
-def _measure_fleet(cfg, traces, chunk: int, runs: int = 2) -> float:
+def _measure_fleet(cfg, traces, chunk: int, runs: int = 2, mesh=None) -> float:
     """Best-of-N timed FleetEngine.run, same warm-up/upload protocol as
-    `_measure`: one compiled program batching len(traces) simulations."""
+    `_measure`: one compiled program batching len(traces) simulations.
+    With `mesh` the fleet state is laid out shard x vmap (DESIGN.md §22)."""
     import numpy as np
 
     import jax.numpy as jnp
 
     from primesim_tpu.sim.fleet import FleetEngine, fleet_run_loop
 
-    warm = FleetEngine(cfg, traces, chunk_steps=chunk)
+    warm = FleetEngine(cfg, traces, chunk_steps=chunk, mesh=mesh)
     out = fleet_run_loop(
         warm.geom_cfg, chunk, warm.events, warm.state,
         jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
@@ -104,7 +109,7 @@ def _measure_fleet(cfg, traces, chunk: int, runs: int = 2) -> float:
     with recompile_sentinel(allowed=0, watch=("fleet",),
                             label="bench fleet timed loop"):
         for _ in range(runs):
-            fl = FleetEngine(cfg, traces, chunk_steps=chunk)
+            fl = FleetEngine(cfg, traces, chunk_steps=chunk, mesh=mesh)
             fl.block_until_ready()
             t0 = time.perf_counter()
             fl.run(max_steps=10_000_000)
@@ -215,6 +220,37 @@ def main() -> None:
         total_ins = sum(t.total_instructions() for t in trs)
         wall_b = _measure_fleet(cfg1, trs, CHUNK)
         fleet_scaling[str(bsz)] = round(total_ins / wall_b / 1e6, 3)
+
+    # fleet shard scaling: the batch-8 fleet above with its state laid
+    # out over 1/4/8 devices (shard x vmap, DESIGN.md §22) — aggregate
+    # MIPS per mesh size. On the CI virtual CPU mesh the devices share
+    # one socket, so the floor is advisory (non-decreasing 1 -> 8 is the
+    # shape a real pod should show); it records pass/fail but never
+    # fails the run. PRIMETPU_BENCH_SHARD=0 skips (metric reports null),
+    # as does a host with fewer than 8 visible devices.
+    fleet_shard_scaling = None
+    fleet_shard_gate = None
+    if os.environ.get("PRIMETPU_BENCH_SHARD", "1") != "0":
+        import jax
+
+        if len(jax.devices()) >= 8:
+            from primesim_tpu.parallel.sharding import tile_mesh
+
+            total_ins = sum(t.total_instructions() for t in fleet_traces)
+            fleet_shard_scaling = {}
+            for nd in (1, 4, 8):
+                wall_d = _measure_fleet(
+                    cfg1, fleet_traces, CHUNK, mesh=tile_mesh(nd))
+                fleet_shard_scaling[str(nd)] = round(
+                    total_ins / wall_d / 1e6, 3)
+            fleet_shard_gate = {
+                "floor": "MIPS(1) <= MIPS(4) <= MIPS(8)",
+                "hard": False,
+                "passed": bool(
+                    fleet_shard_scaling["1"] <= fleet_shard_scaling["4"]
+                    <= fleet_shard_scaling["8"]
+                ),
+            }
 
     # serve throughput: the continuous-batching scheduler (serve/) kept
     # at sustained 8-slot occupancy on the same rung-1 config/workload as
@@ -642,6 +678,11 @@ def main() -> None:
                     # (rung-1/64-core config, one distinct trace per
                     # element)
                     "fleet_scaling": fleet_scaling,
+                    # the batch-8 fleet sharded over 1/4/8 devices
+                    # (shard x vmap, DESIGN.md §22); advisory floor,
+                    # null when PRIMETPU_BENCH_SHARD=0 or < 8 devices
+                    "fleet_shard_scaling": fleet_shard_scaling,
+                    "fleet_shard_scaling_gate": fleet_shard_gate,
                     # continuous-batching service throughput at sustained
                     # 8-slot occupancy (null when PRIMETPU_BENCH_SERVE=0)
                     "serve_throughput": serve_detail,

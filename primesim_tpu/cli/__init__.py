@@ -251,6 +251,103 @@ def _apply_faults(ns, cfg):
     return cfg
 
 
+def _build_mesh(ns, cfg):
+    """--devices N -> a validated tile mesh (or None). Multi-chip: shard
+    cores/L1s/events by core and the LLC/directory by bank over the first
+    N visible devices; virtual CPU meshes work too
+    (XLA_FLAGS=--xla_force_host_platform_device_count=N
+    JAX_PLATFORMS=cpu). A bad N (doesn't divide the core/bank axes, or
+    more devices than visible) raises the typed DeviceMeshError -> exit 2
+    with a structured {"error": ...} line."""
+    if not getattr(ns, "devices", 0):
+        return None
+    from ..parallel.sharding import tile_mesh, validate_devices
+
+    validate_devices(cfg, ns.devices)
+    mesh = tile_mesh(ns.devices)
+    print(
+        f"mesh: {ns.devices} devices "
+        f"({mesh.devices.flat[0].platform})",
+        file=sys.stderr,
+    )
+    return mesh
+
+
+def _run_pipelined_cli(ns, cfg, tr, mesh, rec) -> int:
+    """`run --stream-window W --ingest-workers K`: the pipelined rung-5
+    path (DESIGN.md §22). Pool ingest workers materialize trace segments
+    ahead of a supervised PipelineStreamEngine in THIS process; the
+    supervisor contract (checkpoints/resume/guard/preemption) is the
+    stream engine's, unchanged."""
+    import os
+
+    from ..ingest.pipeline import run_pipelined
+    from ..sim.supervisor import Preempted
+
+    traces = ns.trace or []
+    if len(traces) + (1 if ns.synth else 0) != 1:
+        raise SystemExit(
+            "--ingest-workers needs exactly one --trace file or one "
+            "--synth spec (workers re-materialize the source from its "
+            "portable spec)"
+        )
+    if traces and ns.fold:
+        raise SystemExit(
+            "--ingest-workers does not compose with --fold for trace "
+            "files yet (ingest workers re-read the raw file)"
+        )
+    trace_path = os.path.abspath(traces[0]) if traces else None
+    sup_kwargs = dict(
+        snapshot_dir=ns.checkpoint_dir,
+        keep_snapshots=ns.keep_snapshots,
+        checkpoint_every_chunks=ns.checkpoint_every,
+        checkpoint_every_s=ns.checkpoint_wall,
+        guard=ns.guard,
+        max_retries=ns.max_retries,
+        obs=rec,
+    )
+    t0 = time.perf_counter()
+    try:
+        eng, sup, ingest = run_pipelined(
+            cfg, tr,
+            trace_path=trace_path,
+            synth_spec=ns.synth if not traces else None,
+            window_events=ns.stream_window,
+            seg_events=ns.seg_events or None,
+            ingest_workers=ns.ingest_workers,
+            pool_dir=ns.pool_dir,
+            mesh=mesh,
+            supervisor_kwargs=sup_kwargs,
+            max_steps=ns.max_steps,
+            resume=bool(ns.resume),
+            obs=rec,
+            log=lambda m: print(f"run: {m}", file=sys.stderr),
+        )
+    except Preempted as e:
+        _finalize_obs(rec)
+        return _emit_preempted(e, e.supervisor)
+    wall = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": "ingest_pipeline",
+                "value": ingest["segments"],
+                "unit": "segments",
+                "detail": ingest,
+            }
+        )
+    )
+    for line in sup.log_lines():
+        print(f"supervisor: {line}", file=sys.stderr)
+    _emit_summary(
+        ns, cfg, ns.engine, eng.counters, eng.cycles, wall,
+        extra=sup.summary(),
+        timeline=rec.timeline_summary() if rec is not None else None,
+    )
+    _finalize_obs(rec)
+    return 0
+
+
 def cmd_run(ns) -> int:
     cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
     if cfg.faults_enabled and ns.engine == "golden":
@@ -309,12 +406,18 @@ def cmd_run(ns) -> int:
         # host O(1) with --mmap; bit-exact vs the preloaded engine
         from ..ingest.stream import StreamEngine
 
-        if ns.xprof or ns.debug_invariants or ns.devices:
+        if ns.xprof or ns.debug_invariants:
             raise SystemExit(
-                "--xprof/--debug-invariants/--devices are not supported "
-                "with --stream-window yet"
+                "--xprof/--debug-invariants are not supported with "
+                "--stream-window yet"
             )
-        eng = StreamEngine(cfg, tr, window_events=ns.stream_window)
+        mesh = _build_mesh(ns, cfg)
+        if ns.ingest_workers:
+            # rung-5 pipelined path (DESIGN.md §22): pool workers ingest
+            # trace segments ahead of a supervised stream engine
+            return _run_pipelined_cli(ns, cfg, tr, mesh, rec)
+        eng = StreamEngine(cfg, tr, window_events=ns.stream_window,
+                           mesh=mesh)
         # warm the jit cache at the run's window shapes so the reported
         # MIPS measures simulation, not compilation — same protocol as the
         # preloaded path above
@@ -335,20 +438,7 @@ def cmd_run(ns) -> int:
 
         from ..sim.engine import Engine, run_chunk, run_loop
 
-        mesh = None
-        if ns.devices:
-            # multi-chip: shard cores/L1s/events by core and the LLC/
-            # directory by bank over the first N visible devices (virtual
-            # CPU meshes work too: XLA_FLAGS=--xla_force_host_platform_
-            # device_count=N JAX_PLATFORMS=cpu)
-            from ..parallel.sharding import tile_mesh
-
-            mesh = tile_mesh(ns.devices)
-            print(
-                f"mesh: {ns.devices} devices "
-                f"({mesh.devices.flat[0].platform})",
-                file=sys.stderr,
-            )
+        mesh = _build_mesh(ns, cfg)
 
         # warm the jit cache at the measured shapes (one chunk) so the
         # reported MIPS measures simulation, not compilation — the same
@@ -592,13 +682,15 @@ def cmd_sweep(ns) -> int:
 
     supervised = _supervised(ns)
     rec = _build_recorder(ns)
+    mesh = _build_mesh(ns, cfg)
     if ns.strict:
         traces = [s() if callable(s) else s for s in sources]
-        fleet = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps)
+        fleet = FleetEngine(cfg, traces, ovs, chunk_steps=ns.chunk_steps,
+                            mesh=mesh)
         quarantined: list = []
     else:
         fleet, quarantined = build_fleet_isolated(
-            cfg, sources, ovs, chunk_steps=ns.chunk_steps
+            cfg, sources, ovs, chunk_steps=ns.chunk_steps, mesh=mesh
         )
     from ..serve.protocol import error_obj
 
@@ -654,6 +746,7 @@ def cmd_sweep(ns) -> int:
                 [fleet.traces[j] for j in keep],
                 [fleet.element_overrides[j] for j in keep],
                 chunk_steps=ns.chunk_steps,
+                mesh=mesh,
             )
             fleet.element_ids = kept_ids
 
@@ -663,7 +756,7 @@ def cmd_sweep(ns) -> int:
     # fused path fleet_run_loop — warm what will run.
     warm = FleetEngine(
         cfg, fleet.traces, fleet.element_overrides,
-        chunk_steps=ns.chunk_steps,
+        chunk_steps=ns.chunk_steps, mesh=mesh,
     )
     if supervised or rec is not None:
         out_st = fleet_run_chunk(
@@ -1109,6 +1202,15 @@ def cmd_serve(ns) -> int:
     rec = _build_recorder(ns)
     if ns.tcp and ns.socket:
         raise SystemExit("--tcp and --socket are mutually exclusive")
+    if getattr(ns, "devices", 0) and not ns.pool_dir:
+        raise SystemExit(
+            "serve: --devices needs dispatch mode (--pool-dir): sharded "
+            "fleets live on pool workers, not in the front-end process"
+        )
+    if getattr(ns, "devices", 0):
+        from ..parallel.sharding import validate_devices
+
+        validate_devices(cfg, ns.devices)
     replicas = [t.strip() for t in (ns.replicas or "").split(",")
                 if t.strip()]
     if ns.standby_of:
@@ -1154,6 +1256,7 @@ def cmd_serve(ns) -> int:
         replicas=replicas or None,
         quorum=ns.quorum,
         quorum_policy=ns.quorum_policy,
+        devices=getattr(ns, "devices", 0) or 0,
     )
     # bind before the readiness line so `--tcp HOST:0` prints the real
     # kernel-assigned port (tests and scripts scrape this line)
@@ -1535,6 +1638,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="shard the simulated machine over the first N jax devices "
              "(cores/L1s by core, LLC/directory by bank; jax engine)",
     )
+    r.add_argument(
+        "--ingest-workers", type=int, default=0, metavar="K",
+        help="(--stream-window) pipeline the window fill MPMD-style: K "
+             "pool worker processes ingest trace segments over the lease "
+             "protocol, ahead of the (supervised) simulation in this "
+             "process (DESIGN.md §22)",
+    )
+    r.add_argument(
+        "--seg-events", type=int, default=0, metavar="L",
+        help="(--ingest-workers) events/core per ingest segment "
+             "(default: max(--stream-window, 4096))",
+    )
+    r.add_argument(
+        "--pool-dir", default=None, metavar="DIR",
+        help="(--ingest-workers) segment files + ingest lease ledger "
+             "live here; re-running with the same DIR re-uses segments "
+             "already ingested (default: a throwaway temp dir)",
+    )
     _add_resilience_flags(r)
     _add_fault_flags(r)
     _add_obs_flags(r)
@@ -1593,6 +1714,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable fleet fault isolation: any malformed element "
              "(unreadable trace, bad overrides) aborts the whole sweep "
              "instead of being quarantined into its own JSON line",
+    )
+    w.add_argument(
+        "--devices", type=int, default=0, metavar="N",
+        help="shard EVERY fleet element over the first N jax devices "
+             "(shard x vmap, DESIGN.md §22: cores/L1s by core, LLC/"
+             "directory by bank, under the element batch; still one "
+             "compiled program per geometry); with --workers each worker "
+             "owns a sharded fleet on its own mesh",
     )
     w.add_argument(
         "--workers", type=int, default=0, metavar="N",
@@ -1756,6 +1885,12 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument(
         "--lease-ttl", type=float, default=10.0, metavar="SEC",
         help="dispatch mode: pool lease TTL (default 10)",
+    )
+    v.add_argument(
+        "--devices", type=int, default=0, metavar="N",
+        help="dispatch mode: every leased unit runs on a fleet sharded "
+             "over N jax devices (shard x vmap; the mesh shape joins the "
+             "unit's geometry bucket)",
     )
     v.add_argument(
         "--quota", default=None, metavar="RATE[:BURST]",
@@ -2026,13 +2161,14 @@ def main(argv=None) -> int:
     ns = build_parser().parse_args(argv)
     from ..analysis.errors import AnalysisError, FsckCorrupt
     from ..config.machine import FaultConfigError
+    from ..parallel.sharding import DeviceMeshError
     from ..sim.checkpoint import CheckpointCorrupt
     from ..trace.format import TraceError
 
     try:
         return ns.fn(ns)
     except (TraceError, FaultConfigError, CheckpointCorrupt, VarySpecError,
-            AnalysisError, FsckCorrupt) as e:
+            AnalysisError, FsckCorrupt, DeviceMeshError) as e:
         # typed errors exit 2 with ONE structured JSON line on stderr —
         # {"error": {type, location, detail}} — the same shape the serve
         # protocol and sweep quarantine lines use, so scripts parse one

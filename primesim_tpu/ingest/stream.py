@@ -81,6 +81,7 @@ class StreamEngine:
         cfg: MachineConfig,
         trace: Trace,
         window_events: int = 1024,
+        mesh=None,
     ):
         assert trace.n_cores == cfg.n_cores
         if window_events < max(1, cfg.local_run_len + 1):
@@ -118,6 +119,16 @@ class StreamEngine:
                 "streaming 64-step counter drain; split INS batches"
             )
         self.state = init_state(cfg)
+        # multi-chip layout (DESIGN.md §22): shard the machine over the
+        # mesh's "tiles" axis at init; stream_loop outputs keep it by
+        # propagation, so only the per-window fresh uploads (window
+        # buffer, exhausted/filled masks, the reset ptr) need explicit
+        # placement — see _place_core_axis/_zero_ptr.
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharding import shard_state
+
+            self.state = shard_state(mesh, self.state)
         self.cycle_base = np.int64(0)
         self.host_counters = zero_counters(cfg.n_cores)
         self.steps_run = 0
@@ -157,6 +168,27 @@ class StreamEngine:
             )
         return buf, exhausted, filled
 
+    def _place_core_axis(self, x):
+        """Upload a host array whose leading axis is the core axis,
+        sharded over the mesh when one is set (fresh uploads carry no
+        sharding of their own to propagate from)."""
+        a = jnp.asarray(x)
+        if self.mesh is None:
+            return a
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import AXIS
+
+        return jax.device_put(a, NamedSharding(self.mesh, P(AXIS)))
+
+    def _zero_ptr(self):
+        """The per-window ptr reset, placed like state.ptr so the reset
+        cannot silently drop the mesh layout mid-run."""
+        return self._place_core_axis(
+            np.zeros(self.cfg.n_cores, np.int32)
+        )
+
     def warmup(self) -> None:
         """Compile `stream_loop` at this run's window shapes with a
         ZERO-step budget (the budget is a traced arg, so the real run
@@ -168,10 +200,10 @@ class StreamEngine:
         buf, exhausted, filled = self._fill_window()
         out = stream_loop(
             cfg,
-            jnp.asarray(buf),
-            self.state._replace(ptr=jnp.zeros(cfg.n_cores, jnp.int32)),
-            jnp.asarray(exhausted),
-            jnp.asarray(filled),
+            self._place_core_axis(buf),
+            self.state._replace(ptr=self._zero_ptr()),
+            self._place_core_axis(exhausted),
+            self._place_core_axis(filled),
             jnp.asarray(0, jnp.int32),
             has_sync=self.has_sync,
         )
@@ -184,17 +216,16 @@ class StreamEngine:
         CONSISTENT CUT — cursors and state fully describe the run — which
         is what makes streaming checkpoints possible."""
         cfg = self.cfg
-        C = cfg.n_cores
         t0 = time.perf_counter() if self.obs is not None else 0.0
         buf, exhausted, filled = self._fill_window()
         t1 = time.perf_counter() if self.obs is not None else 0.0
-        st = self.state._replace(ptr=jnp.zeros(C, jnp.int32))
+        st = self.state._replace(ptr=self._zero_ptr())
         out = stream_loop(
             cfg,
-            jnp.asarray(buf),
+            self._place_core_axis(buf),
             st,
-            jnp.asarray(exhausted),
-            jnp.asarray(filled),
+            self._place_core_axis(exhausted),
+            self._place_core_axis(filled),
             jnp.asarray(min(budget, 2**31 - 1), jnp.int32),
             has_sync=self.has_sync,
         )

@@ -36,6 +36,51 @@ from ..sim.state import MachineState, TimingKnobs
 AXIS = "tiles"
 
 
+class DeviceMeshError(ValueError):
+    """Typed `--devices N` validation failure (CLI exit 2, structured
+    ``{"error": …}`` on stderr) raised BEFORE any compile, instead of the
+    mid-compile shape error XLA would produce for a non-dividing mesh."""
+
+    def __init__(self, detail: str, *, devices: int, visible: int | None = None):
+        super().__init__(detail)
+        self.devices = devices
+        self.visible = visible
+
+    def location(self):
+        loc = {"devices": self.devices}
+        if self.visible is not None:
+            loc["visible"] = self.visible
+        return loc
+
+
+def validate_devices(cfg, n_devices: int) -> None:
+    """Validate a `--devices N` request against the machine geometry and
+    the visible device set. Raises DeviceMeshError (exit 2 at the CLI)
+    on any mismatch; returns None when a tile_mesh(n_devices) run of this
+    config is shape-sound."""
+    if n_devices < 1:
+        raise DeviceMeshError(
+            f"--devices must be >= 1, got {n_devices}", devices=n_devices
+        )
+    visible = len(jax.devices())
+    if n_devices > visible:
+        raise DeviceMeshError(
+            f"--devices {n_devices} exceeds the {visible} visible "
+            f"device(s); set XLA_FLAGS=--xla_force_host_platform_device_"
+            f"count={n_devices} for a virtual CPU mesh",
+            devices=n_devices,
+            visible=visible,
+        )
+    for name, extent in (("n_cores", cfg.n_cores), ("n_banks", cfg.n_banks)):
+        if extent % n_devices != 0:
+            raise DeviceMeshError(
+                f"--devices {n_devices} does not divide {name}={extent}; "
+                f"the {AXIS!r} mesh axis shards cores and banks evenly",
+                devices=n_devices,
+                visible=visible,
+            )
+
+
 def tile_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """1-D device mesh over the tile axis (the only axis the sim needs:
     cores and banks shard over the same tile sub-grids)."""
@@ -115,3 +160,30 @@ def shard_state(mesh: Mesh, st: MachineState) -> MachineState:
 
 def shard_events(mesh: Mesh, events) -> jax.Array:
     return jax.device_put(events, NamedSharding(mesh, events_pspec()))
+
+
+def fleet_state_pspecs() -> MachineState:
+    """state_pspecs() lifted under the fleet's leading batch axis: every
+    leaf gains an UNSHARDED leading dim (elements replicate across the
+    mesh; cores/banks shard within each element, shard x vmap)."""
+    solo = state_pspecs()
+    return jax.tree.map(
+        lambda spec: P(None, *spec),
+        solo,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def fleet_events_pspec() -> P:
+    return P(None, AXIS)  # events[Batch, C, T, 4]: batch whole, core-sharded
+
+
+def shard_fleet_state(mesh: Mesh, st: MachineState) -> MachineState:
+    specs = fleet_state_pspecs()
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), st, specs
+    )
+
+
+def shard_fleet_events(mesh: Mesh, events) -> jax.Array:
+    return jax.device_put(events, NamedSharding(mesh, fleet_events_pspec()))

@@ -51,6 +51,11 @@ def unit_key(unit: dict) -> str:
         for k in ("index", "config", "trace_path", "synth", "fold",
                   "overrides", "chunk_steps", "max_steps")
     }
+    # later workload dimensions join the identity only when SET, so every
+    # pre-existing ledger key (no mesh, sim-kind units) stays unchanged
+    for k in ("devices", "kind", "seg_events", "seg_index"):
+        if unit.get(k):
+            payload[k] = unit.get(k)
     blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
@@ -64,6 +69,7 @@ def build_units(
     chunk_steps: int,
     max_steps: int,
     warm_cache: bool = False,
+    devices: int = 0,
 ) -> list[dict]:
     """Decompose a sweep (the CLI's fan rule output: sources and
     overrides already paired 1:1) into per-element work units. Trace
@@ -90,6 +96,52 @@ def build_units(
             "chunk_steps": int(chunk_steps),
             "max_steps": int(max_steps),
             "warm_cache": bool(warm_cache),
+        }
+        if devices:
+            # mesh shape is part of the leased workload's identity: an
+            # acked result must have been produced on the geometry bucket
+            # the campaign asked for (shard x vmap, DESIGN.md §22)
+            unit["devices"] = int(devices)
+        unit["key"] = unit_key(unit)
+        units.append(unit)
+    return units
+
+
+def build_ingest_units(
+    cfg,
+    trace_path: str | None,
+    synth_spec: str | None,
+    seg_events: int,
+    n_segments: int,
+    chunk_steps: int = 0,
+) -> list[dict]:
+    """Decompose a rung-scale streaming run's INGEST stage into one work
+    unit per fixed-size trace segment (MPMD pipeline stage 1, DESIGN.md
+    §22): unit k materializes per-core events [k*L, (k+1)*L) of the
+    source — line-normalized, END-padded — into an atomic npz under the
+    pool dir. Segments are mutually independent, so the existing lease
+    protocol (hedging, poison, resume) applies unchanged."""
+    if (trace_path is None) == (synth_spec is None):
+        # caller contract, not a user-reachable path: the CLI rejects a
+        # bad --trace/--synth combination before building units
+        # ptlint: allow=PT-TYPED-ERR
+        raise ValueError("ingest units need exactly one of trace/synth source")
+    cfg_json = cfg.to_json()
+    units = []
+    for k in range(n_segments):
+        unit = {
+            "unit_id": f"g{k:05d}",
+            "index": k,
+            "kind": "ingest",
+            "config": cfg_json,
+            "trace_path": trace_path,
+            "synth": synth_spec,
+            "fold": False,
+            "overrides": {},
+            "chunk_steps": int(chunk_steps),
+            "max_steps": 0,
+            "seg_events": int(seg_events),
+            "seg_index": k,
         }
         unit["key"] = unit_key(unit)
         units.append(unit)

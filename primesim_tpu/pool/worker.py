@@ -278,21 +278,36 @@ class PoolWorker:
         finally:
             hb.stop()
 
+    def _unit_mesh(self, unit, cfg):
+        """The device mesh a unit's `devices` field asks for (None for
+        the default solo layout). Validation is typed so a bad mesh
+        request quarantines with a structured error instead of a
+        mid-compile shape failure."""
+        devices = int(unit.get("devices") or 0)
+        if not devices:
+            return None
+        from ..parallel.sharding import tile_mesh, validate_devices
+
+        validate_devices(cfg, devices)
+        return tile_mesh(devices)
+
     def _bucket_fleet(self, unit, cfg):
         """The warm compiled slot fleet for a unit's geometry bucket
         (`capacity_pages` units = serve jobs dispatched by the elastic
-        front-end). Compiled once per (config, capacity, chunk_steps)
-        and reused across every unit in the bucket — `replace_element`
-        splices workloads without recompiling."""
+        front-end). Compiled once per (config, capacity, chunk_steps,
+        devices) and reused across every unit in the bucket —
+        `replace_element` splices workloads without recompiling."""
         from ..serve.scheduler import PAGE_EVENTS
         from ..sim.fleet import FleetEngine
 
         cap = int(unit["capacity_pages"]) * PAGE_EVENTS
-        key = (unit["config"], cap, int(unit["chunk_steps"]))
+        devices = int(unit.get("devices") or 0)
+        key = (unit["config"], cap, int(unit["chunk_steps"]), devices)
         fleet = self._bucket_fleets.get(key)
         if fleet is None:
             fleet = FleetEngine.make_slots(
-                cfg, 1, cap, chunk_steps=int(unit["chunk_steps"])
+                cfg, 1, cap, chunk_steps=int(unit["chunk_steps"]),
+                mesh=self._unit_mesh(unit, cfg),
             )
             self._bucket_fleets[key] = fleet
         return fleet
@@ -307,6 +322,10 @@ class PoolWorker:
         from ..trace.format import Trace, fold_ins
 
         cfg = MachineConfig.from_json(unit["config"])
+        if unit.get("kind") == "ingest":
+            # MPMD pipeline stage 1 (DESIGN.md §22): materialize one trace
+            # segment to the pool dir instead of simulating anything
+            return self._ingest_segment(grant, unit, cfg, hb)
         if unit["synth"] is not None:
             trace = parse_synth_spec(unit["synth"], cfg.n_cores,
                                      unit["fold"])
@@ -322,6 +341,7 @@ class PoolWorker:
             fleet = FleetEngine(
                 cfg, [trace], [dict(unit["overrides"])],
                 chunk_steps=int(unit["chunk_steps"]),
+                mesh=self._unit_mesh(unit, cfg),
             )
 
         resumed_steps = 0
@@ -365,7 +385,8 @@ class PoolWorker:
                     self._bucket_fleets.pop(
                         (unit["config"],
                          fleet.events_capacity,
-                         int(unit["chunk_steps"])), None)
+                         int(unit["chunk_steps"]),
+                         int(unit.get("devices") or 0)), None)
             raise
         wall = time.perf_counter() - t0
 
@@ -388,6 +409,10 @@ class PoolWorker:
                 "noc_msgs": int(ec["noc_msgs"].sum()),
             },
         }
+        if unit.get("devices"):
+            # present ONLY for sharded campaigns, so unsharded sweep
+            # records stay byte-identical for the pool-chaos CI diff
+            result["detail"]["devices"] = int(unit["devices"])
         if unit.get("serve_job"):
             # the front-end maps this into the serve job's result and
             # bit-exactness tests diff it against a solo Engine run —
@@ -403,6 +428,47 @@ class PoolWorker:
         if bucketed:
             fleet.clear_element(0)
         return result, resumed_steps
+
+    def _ingest_segment(self, grant, unit, cfg, hb) -> tuple[dict, int]:
+        """Execute one MPMD ingest unit: materialize trace segment
+        `seg_index` (line-normalized, END-padded) and write it atomically
+        under the pool dir for the sim stage to consume. Deterministic,
+        so hedged twins and re-leases produce identical bytes."""
+        from ..ingest.pipeline import (
+            normalize_segment,
+            segment_path,
+            write_segment,
+        )
+        from ..serve.scheduler import parse_synth_spec
+        from ..trace.format import Trace
+
+        if unit["synth"] is not None:
+            trace = parse_synth_spec(unit["synth"], cfg.n_cores,
+                                     unit["fold"])
+        else:
+            trace = Trace.load(unit["trace_path"], mmap=True)
+        k = int(unit["seg_index"])
+        L = int(unit["seg_events"])
+        t0 = time.perf_counter()
+        arr, n_valid = normalize_segment(cfg, trace, k, L)
+        path = segment_path(grant["pool_dir"], k)
+        write_segment(path, k, L, arr)
+        if hb.lost:
+            raise LeaseLost(unit["unit_id"])
+        return {
+            "metric": "ingested_events",
+            "value": n_valid,
+            "unit": "events",
+            "detail": {
+                "engine": "ingest",
+                "fleet_index": unit["index"],
+                "seg_index": k,
+                "seg_events": L,
+                "n_cores": cfg.n_cores,
+                "path": path,
+                "wall_s": round(time.perf_counter() - t0, 3),
+            },
+        }, 0
 
     def _checkpoint(self, path: str, fleet, unit_id: str) -> None:
         from ..sim.checkpoint import save_element_checkpoint

@@ -72,6 +72,7 @@ class DispatchScheduler:
         obs=None,
         spawn: bool = True,
         poll_every_s: float = 0.2,
+        devices: int = 0,
     ):
         self.cfg = cfg
         self.journal = journal
@@ -87,6 +88,13 @@ class DispatchScheduler:
         self.lease_ttl_s = float(lease_ttl_s)
         self.spawn = bool(spawn)  # False: tests run coord/workers themselves
         self.poll_every_s = float(poll_every_s)
+        self.devices = int(devices)
+        if self.devices:
+            # fail service bring-up on a bad mesh shape, not every
+            # leased unit on every worker
+            from ..parallel.sharding import validate_devices
+
+            validate_devices(cfg, self.devices)
 
         self.jobs: dict[str, J.Job] = {}
         self.queue: list[str] = []  # accepted, not yet enqueued remotely
@@ -188,6 +196,10 @@ class DispatchScheduler:
             "priority": int(job.priority),
             "client": str(job.client),
         }
+        if self.devices:
+            # geometry bucket with a mesh shape: the leasing worker owns
+            # a sharded fleet over this many devices (shard x vmap)
+            spec["devices"] = self.devices
         spec["key"] = unit_key(spec)
         return spec
 

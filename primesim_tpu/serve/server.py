@@ -82,6 +82,7 @@ class PrimeServer:
         quorum: int | None = None,
         quorum_policy: str = "block",
         node: str | None = None,
+        devices: int = 0,
     ):
         self.state_dir = str(state_dir)
         os.makedirs(self.state_dir, exist_ok=True)
@@ -126,8 +127,18 @@ class PrimeServer:
                 lease_ttl_s=lease_ttl_s,
                 obs=obs,
                 spawn=spawn_pool,
+                devices=devices,
             )
         else:
+            if devices:
+                # caller contract, not a user-reachable path: cmd_serve
+                # rejects --devices without --pool-dir before constructing
+                # ptlint: allow=PT-TYPED-ERR
+                raise ValueError(
+                    "serve --devices needs dispatch mode (--pool-dir): "
+                    "sharded fleets live on pool workers, not in the "
+                    "front-end process"
+                )
             self.sched = Scheduler(
                 cfg,
                 self.journal,

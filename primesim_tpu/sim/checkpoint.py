@@ -292,7 +292,13 @@ def load_stream_checkpoint(path: str, eng) -> None:
             f"{path}: checkpoint window_events {int(z['window_events'])} "
             f"!= engine {eng.W} (windows must match for bit-exact resume)"
         )
-    eng.state = _state_from(z)
+    st = _state_from(z)
+    if getattr(eng, "mesh", None) is not None:
+        # restore the multi-chip layout StreamEngine.__init__ applies
+        from ..parallel.sharding import shard_state
+
+        st = shard_state(eng.mesh, st)
+    eng.state = st
     eng.cursor = z["cursor"].astype(np.int64)
     eng.cycle_base = np.int64(z["cycle_base"])
     eng.steps_run = int(z["steps_run"])
@@ -498,7 +504,13 @@ def load_fleet_checkpoint(path: str, fleet) -> None:
             f"rows but this build defines {len(COUNTER_NAMES)} — saved by an "
             "incompatible version"
         )
-    fleet.state = _state_from(z)
+    st = _state_from(z)
+    if getattr(fleet, "mesh", None) is not None:
+        # restore the shard x vmap layout FleetEngine.__init__ applies
+        from ..parallel.sharding import shard_fleet_state
+
+        st = shard_fleet_state(fleet.mesh, st)
+    fleet.state = st
     fleet.cycle_base = z["cycle_base"].astype(np.int64)
     fleet.steps_run = z["steps_run"].astype(np.int64)
     if "prefix_steps" in z:

@@ -199,6 +199,7 @@ class FleetEngine:
         chunk_steps: int = 256,
         min_events_capacity: int = 0,
         force_sync: bool = False,
+        mesh=None,
     ):
         if cfg.pallas_reduce:
             raise ValueError(
@@ -297,6 +298,24 @@ class FleetEngine:
         # prefix was saved/loaded under (None = element ran from step 0)
         self.prefix_steps = np.zeros(B, np.int64)
         self.prefix_cache_keys: list = [None] * B
+        # shard x vmap (DESIGN.md §22): each element's cores/banks lay out
+        # over the mesh's "tiles" axis UNDER the batch vmap (batch dim
+        # replicated, per-element layout = the solo state_pspecs). Like the
+        # solo Engine, only the INPUTS are placed — the compiled loops'
+        # output shardings follow by propagation, which the multichip
+        # parity/HLO suites prove is both bit-exact and all-gather-free.
+        self.mesh = mesh
+        if mesh is not None:
+            self._reshard()
+
+    def _reshard(self) -> None:
+        """Re-place events and state on the fleet mesh layout. Called at
+        init and after any host-side state surgery (splice/restore/fork)
+        whose `.at[i].set` output sharding is not guaranteed to match."""
+        from ..parallel.sharding import shard_fleet_events, shard_fleet_state
+
+        self.events = shard_fleet_events(self.mesh, self.events)
+        self.state = shard_fleet_state(self.mesh, self.state)
 
     # ---- batched bookkeeping (Engine's host helpers, vectorized) ---------
 
@@ -514,6 +533,7 @@ class FleetEngine:
         n_slots: int,
         capacity_events: int,
         chunk_steps: int = 256,
+        mesh=None,
     ) -> "FleetEngine":
         """An all-idle serving fleet: `n_slots` elements holding the empty
         workload (`idle_trace`), with event storage reserved for traces up
@@ -527,6 +547,7 @@ class FleetEngine:
             chunk_steps=chunk_steps,
             min_events_capacity=capacity_events,
             force_sync=True,
+            mesh=mesh,
         )
 
     @property
@@ -604,6 +625,8 @@ class FleetEngine:
         self.prefix_cache_keys[i] = None
         for k in self.host_counters:
             self.host_counters[k][i] = 0
+        if self.mesh is not None:
+            self._reshard()
         if upload:
             self.upload_events()
 
@@ -628,6 +651,8 @@ class FleetEngine:
         self.steps_run[i] = snap["steps_run"]
         for k in COUNTER_NAMES:
             self.host_counters[k][i] = snap["host_counters"][k]
+        if self.mesh is not None:
+            self._reshard()
 
     def fork_element(self, i: int, snap: dict, cache_key: str | None = None) -> None:
         """Fork batch position `i` from a shared-prefix snapshot: overlay
@@ -676,11 +701,17 @@ class FleetEngine:
         )
         self.prefix_steps[i] = int(snap["steps_run"])
         self.prefix_cache_keys[i] = cache_key
+        if self.mesh is not None:
+            self._reshard()
 
     def upload_events(self) -> None:
         """Push the host event array (mutated by splices) to the device.
         One call covers any number of `upload=False` splices."""
         self.events = jnp.asarray(self._events_np)
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_fleet_events
+
+            self.events = shard_fleet_events(self.mesh, self.events)
 
     def step_chunk(self) -> None:
         """Advance the whole batch by exactly ONE committed chunk (the

@@ -706,6 +706,7 @@ def build_fleet_isolated(
     sources: list,
     overrides: list[dict] | None = None,
     chunk_steps: int = 256,
+    mesh=None,
 ):
     """Build a FleetEngine from per-element sources with fault isolation.
 
@@ -743,6 +744,7 @@ def build_fleet_isolated(
         ids.append(i)
     if not kept:
         return None, quarantined
-    fleet = FleetEngine(cfg, kept, kept_ovs, chunk_steps=chunk_steps)
+    fleet = FleetEngine(cfg, kept, kept_ovs, chunk_steps=chunk_steps,
+                        mesh=mesh)
     fleet.element_ids = ids
     return fleet, quarantined
