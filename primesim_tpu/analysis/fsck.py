@@ -23,6 +23,12 @@ writes, with ZERO simulation and without mutating anything it checks:
     steps, trace_sha); orphan sidecars and mkstemp leftovers are
     reported as notes, not corruption (they are expected kill -9
     debris)
+  - AOT executable entries (exec/*.bin, DESIGN.md §23): magic + CRC of
+    the serialized executable, sidecar key↔content agreement (the
+    payload must re-hash to its own filename), required toolchain
+    version fields; an entry lowered under a different jax/jaxlib is a
+    note (the cache treats it as a plain miss), a tampered one is
+    corrupt
 
 `--repair quarantine` moves (never deletes) corrupt or orphaned FILES
 into `<root>/.fsck-quarantine/<relpath>`; logical findings that span a
@@ -501,6 +507,109 @@ def _check_npz(path: str, rel: str) -> list:
     return findings
 
 
+# ---- AOT executable cache (DESIGN.md §23) ------------------------------
+
+_EXEC_VERSION_FIELDS = ("exec_format", "ckpt_format", "jax", "jaxlib",
+                        "backend", "devices")
+
+
+def _check_exec_bin(path: str, rel: str) -> list:
+    """One exec/*.bin entry: framing, then sidecar↔content agreement.
+    The runtime degrades any of these to miss-and-recompile, so every
+    finding here is about a cache that silently stopped paying, not a
+    wrong simulation."""
+    import struct
+    import zlib
+
+    from ..sim.exec_cache import _MAGIC, exec_key
+
+    findings: list = []
+    stem = os.path.basename(path)[:-len(".bin")]
+    try:
+        with open(path, "rb") as f:
+            record = f.read()
+    except OSError as e:
+        return [Finding("exec-cache", rel, f"unreadable entry: {e}",
+                        corrupt=True, repairable=True)]
+    head = len(_MAGIC) + 4
+    if len(record) < head or record[:len(_MAGIC)] != _MAGIC:
+        return [Finding(
+            "exec-cache", rel,
+            "bad magic / truncated — not a serialized executable (the "
+            "cache misses-and-recompiles; safe to quarantine)",
+            corrupt=True, repairable=True,
+        )]
+    (crc,) = struct.unpack("<I", record[len(_MAGIC):head])
+    if zlib.crc32(record[head:]) & 0xFFFFFFFF != crc:
+        return [Finding(
+            "exec-cache", rel,
+            "body fails its CRC — torn write or media rot (the cache "
+            "misses-and-recompiles; safe to quarantine)",
+            corrupt=True, repairable=True,
+        )]
+
+    meta_path = path[:-len(".bin")] + ".json"
+    if not os.path.exists(meta_path):
+        findings.append(Finding(
+            "exec-cache", rel,
+            "exec entry has no JSON sidecar — key↔content agreement "
+            "unverifiable (interrupted save; the entry itself is "
+            "loadable)", corrupt=False, repairable=True,
+        ))
+        return findings
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        findings.append(Finding(
+            "exec-cache", rel, f"unreadable sidecar: {e}",
+            corrupt=True, repairable=True,
+        ))
+        return findings
+    payload = meta.get("payload")
+    if meta.get("key") != stem:
+        findings.append(Finding(
+            "exec-cache", rel,
+            f"sidecar key {str(meta.get('key'))[:12]}… does not match "
+            f"filename stem {stem[:12]}… (renamed entry)",
+            corrupt=True, repairable=True,
+        ))
+    elif not isinstance(payload, dict):
+        findings.append(Finding(
+            "exec-cache", rel, "sidecar carries no key payload",
+            corrupt=True, repairable=True,
+        ))
+    elif exec_key(payload) != stem:
+        findings.append(Finding(
+            "exec-cache", rel,
+            "sidecar payload does not hash to the entry's address — "
+            "edited payload or mismatched sidecar",
+            corrupt=True, repairable=True,
+        ))
+    else:
+        missing = [k for k in _EXEC_VERSION_FIELDS if k not in payload]
+        if missing:
+            findings.append(Finding(
+                "exec-cache", rel,
+                f"payload is missing version field(s): "
+                f"{', '.join(missing)}", corrupt=True, repairable=True,
+            ))
+        else:
+            import jax
+
+            if (payload["jax"] != jax.__version__
+                    or payload["jaxlib"] != jax.lib.__version__):
+                findings.append(Finding(
+                    "exec-cache", rel,
+                    f"entry was lowered under jax {payload['jax']}/"
+                    f"jaxlib {payload['jaxlib']}; this toolchain is "
+                    f"{jax.__version__}/{jax.lib.__version__} — a dead "
+                    "address the cache will never read again (prunable, "
+                    "not corrupt)", corrupt=False, repairable=True,
+                ))
+    return findings
+
+
 def _check_warm(path: str, rel: str, z: dict) -> list:
     """Sidecar ↔ filename ↔ npz agreement for one warm entry."""
     findings: list = []
@@ -562,7 +671,7 @@ def run_fsck(root: str, repair: str = "none") -> FsckResult:
 
     findings: list = []
     checked = {"journals": 0, "records": 0, "checkpoints": 0,
-               "warm_entries": 0, "orphans": 0}
+               "warm_entries": 0, "exec_entries": 0, "orphans": 0}
 
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != ".fsck-quarantine"]
@@ -603,12 +712,17 @@ def run_fsck(root: str, repair: str = "none") -> FsckResult:
                        for f in nf) or _is_warm_file(path):
                     checked["warm_entries"] += 1
                 findings.extend(nf)
+            elif name.endswith(".bin") and _is_exec_file(path):
+                checked["exec_entries"] += 1
+                findings.extend(_check_exec_bin(path, rel))
             elif name.endswith(".json") and _looks_like_sidecar(name):
-                if not os.path.exists(path[:-len(".json")] + ".npz"):
+                stem_path = path[:-len(".json")]
+                if not (os.path.exists(stem_path + ".npz")
+                        or os.path.exists(stem_path + ".bin")):
                     checked["orphans"] += 1
                     findings.append(Finding(
                         "orphan", rel,
-                        "warm-cache sidecar with no npz entry (the "
+                        "cache sidecar with no npz/bin entry (the "
                         "entry was pruned or its save was interrupted)",
                         corrupt=False, repairable=True,
                     ))
@@ -634,6 +748,11 @@ def run_fsck(root: str, repair: str = "none") -> FsckResult:
 
 def _is_warm_file(path: str) -> bool:
     stem = os.path.basename(path)[:-len(".npz")]
+    return len(stem) == 64 and all(c in "0123456789abcdef" for c in stem)
+
+
+def _is_exec_file(path: str) -> bool:
+    stem = os.path.basename(path)[:-len(".bin")]
     return len(stem) == 64 and all(c in "0123456789abcdef" for c in stem)
 
 
@@ -664,7 +783,8 @@ def render_human(res: FsckResult) -> str:
         out.append(
             f"checked {c['journals']} journal(s) / {c['records']} "
             f"record(s), {c['checkpoints']} checkpoint(s), "
-            f"{c['warm_entries']} warm entr(ies), {c['orphans']} "
+            f"{c['warm_entries']} warm entr(ies), "
+            f"{c.get('exec_entries', 0)} exec entr(ies), {c['orphans']} "
             f"orphan(s): {len(res.corrupt)} corrupt, "
             f"{len(res.findings) - len(res.corrupt)} note(s)"
         )

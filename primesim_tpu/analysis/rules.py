@@ -213,7 +213,7 @@ def _open_write_mode(call: ast.Call) -> str | None:
 @rule(
     "PT-DURABLE",
     "durable writes are atomic with writer-unique temp names",
-    scope=("/serve/", "/pool/", "checkpoint.py"),
+    scope=("/serve/", "/pool/", "checkpoint.py", "exec_cache.py"),
 )
 def check_durable(tree, ctx):
     for node in ast.walk(tree):
@@ -257,7 +257,7 @@ def check_durable(tree, ctx):
 @rule(
     "PT-CHAOS-SITE",
     "durable writes and socket sends stay behind chaos fault sites",
-    scope=("/serve/", "/pool/", "checkpoint.py"),
+    scope=("/serve/", "/pool/", "checkpoint.py", "exec_cache.py"),
 )
 def check_chaos_site(tree, ctx):
     """A function that fsyncs or sendalls on the serve/pool paths must

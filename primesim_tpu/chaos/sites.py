@@ -38,6 +38,7 @@ SITES = {
     # durable-write sites
     "journal.append": "durable",       # serve/journal.py append fsync
     "checkpoint.write": "durable",     # sim/checkpoint.py atomic replace
+    "exec_cache.write": "durable",     # sim/exec_cache.py atomic replace
     # socket sites (client side of the JSON-lines protocol — serve
     # front door and the pool lease path both ride protocol.request)
     "protocol.send": "socket",

@@ -349,6 +349,14 @@ def _run_pipelined_cli(ns, cfg, tr, mesh, rec) -> int:
 
 
 def cmd_run(ns) -> int:
+    t_start = time.perf_counter()  # time_to_first_step epoch
+    cache = _activate_exec_cache(ns)
+    overlap = getattr(ns, "overlap", "off") == "on"
+    if ns.engine == "golden" and (cache is not None or overlap):
+        raise SystemExit(
+            "--exec-cache/--overlap require --engine jax (the golden "
+            "oracle has no compiled program or device loop)"
+        )
     cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
     if cfg.faults_enabled and ns.engine == "golden":
         raise SystemExit(
@@ -418,12 +426,22 @@ def cmd_run(ns) -> int:
             return _run_pipelined_cli(ns, cfg, tr, mesh, rec)
         eng = StreamEngine(cfg, tr, window_events=ns.stream_window,
                            mesh=mesh)
+        if overlap:
+            print(
+                "overlap: the stream engine's next window is produced by "
+                "the host fill/absorb cycle itself — nothing to "
+                "speculate; running without overlap",
+                file=sys.stderr,
+            )
         # warm the jit cache at the run's window shapes so the reported
         # MIPS measures simulation, not compilation — same protocol as the
         # preloaded path above
         eng.warmup()
+        _emit_ttfs_line(cache, t_start)
         if supervised:
-            return _run_supervised(ns, cfg, eng, rec=rec)
+            rc = _run_supervised(ns, cfg, eng, rec=rec)
+            _emit_exec_cache_line(cache)
+            return rc
         if rec is not None:
             rec.attach(eng)  # streaming always windows; no path change
         t0 = time.perf_counter()
@@ -447,24 +465,35 @@ def cmd_run(ns) -> int:
         # path dispatches run_chunk, not the fused run_loop — warm the
         # function the run will actually use.
         warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
+        from ..sim import exec_cache
+
         if ns.debug_invariants or supervised or rec is not None:
             # the chunked paths (debug + supervised run_steps) dispatch
             # run_chunk, not the fused run_loop — warm what will run
-            out = run_chunk(
-                cfg, ns.chunk_steps, warm.events, warm.state,
-                has_sync=warm.has_sync,
+            # (routed through the exec cache so a warm process pays
+            # deserialization here instead of XLA compile)
+            out = exec_cache.call(
+                run_chunk, "engine.run_chunk",
+                (cfg, ns.chunk_steps), (warm.events, warm.state),
+                {"has_sync": warm.has_sync},
             )
             np.asarray(out.cycles)  # block until compiled + run
         else:
-            out = run_loop(
-                cfg, ns.chunk_steps, warm.events, warm.state,
-                jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+            out = exec_cache.call(
+                run_loop, "engine.run_loop",
+                (cfg, ns.chunk_steps),
+                (warm.events, warm.state, jnp.asarray(1, jnp.int32)),
+                {"has_sync": warm.has_sync},
             )
             np.asarray(out[0].cycles)
+        _emit_ttfs_line(cache, t_start)
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
+        eng.overlap = overlap
         eng.block_until_ready()  # don't bill async uploads to simulation
         if supervised:
-            return _run_supervised(ns, cfg, eng, rec=rec)
+            rc = _run_supervised(ns, cfg, eng, rec=rec)
+            _emit_exec_cache_line(cache)
+            return rc
         if rec is not None:
             rec.attach(eng)
 
@@ -493,6 +522,7 @@ def cmd_run(ns) -> int:
         ns, cfg, ns.engine, counters, cycles, wall,
         timeline=rec.timeline_summary() if rec is not None else None,
     )
+    _emit_exec_cache_line(cache)
     _finalize_obs(rec)
     return 0
 
@@ -627,6 +657,9 @@ def cmd_sweep(ns) -> int:
                 f"sweep: --fork-prefix must be auto, off, or an integer "
                 f"step cap (got {ns.fork_prefix!r})"
             ) from None
+    t_start = time.perf_counter()
+    cache = _activate_exec_cache(ns)
+    overlap = getattr(ns, "overlap", "off") == "on"
     cfg = _apply_faults(ns, _apply_step_impl(ns, _load_config(ns.config)))
     _check_supervision_flags(ns)
     if ns.workers:
@@ -758,18 +791,25 @@ def cmd_sweep(ns) -> int:
         cfg, fleet.traces, fleet.element_overrides,
         chunk_steps=ns.chunk_steps, mesh=mesh,
     )
+    from ..sim import exec_cache
+
     if supervised or rec is not None:
-        out_st = fleet_run_chunk(
-            warm.geom_cfg, warm.chunk_steps, warm.events, warm.state,
-            has_sync=warm.has_sync,
+        out_st = exec_cache.call(
+            fleet_run_chunk, "fleet.run_chunk",
+            (warm.geom_cfg, warm.chunk_steps), (warm.events, warm.state),
+            {"has_sync": warm.has_sync},
         )
         np.asarray(out_st.cycles)
     else:
-        out = fleet_run_loop(
-            warm.geom_cfg, warm.chunk_steps, warm.events, warm.state,
-            jnp.asarray(1, jnp.int32), has_sync=warm.has_sync,
+        out = exec_cache.call(
+            fleet_run_loop, "fleet.run_loop",
+            (warm.geom_cfg, warm.chunk_steps),
+            (warm.events, warm.state, jnp.asarray(1, jnp.int32)),
+            {"has_sync": warm.has_sync},
         )
         np.asarray(out[0].cycles)
+    _emit_ttfs_line(cache, t_start)
+    fleet.overlap = overlap
     fleet.block_until_ready()
     if rec is not None:
         rec.attach(fleet)
@@ -967,6 +1007,7 @@ def cmd_sweep(ns) -> int:
                 )
             )
         _finalize_obs(rec)
+    _emit_exec_cache_line(cache)
     if quarantined or stalled:
         # partial success is a distinct, scriptable outcome: the healthy
         # elements' results are real (exit 0 would hide the casualties,
@@ -989,6 +1030,7 @@ def cmd_worker(ns) -> int:
     is the elastic part)."""
     from ..pool.worker import run_worker
 
+    _activate_exec_cache(ns)  # engines consult the process-global cache
     return run_worker(
         ns.connect,
         ns.worker_id,
@@ -996,6 +1038,7 @@ def cmd_worker(ns) -> int:
         reconnect_timeout_s=ns.reconnect_timeout,
         crash_after_chunks=ns.crash_after_chunks,
         idle_exit_s=ns.idle_exit,
+        overlap=getattr(ns, "overlap", "off") == "on",
     )
 
 
@@ -1199,6 +1242,10 @@ def cmd_serve(ns) -> int:
     from ..serve.quota import TenantQuota
     from ..serve.server import PrimeServer
 
+    # process-global AOT cache: in-process scheduler buckets compile/
+    # deserialize through it; dispatch mode propagates the flag to the
+    # autoscaled workers' argv (serve/dispatch.py)
+    _activate_exec_cache(ns)
     rec = _build_recorder(ns)
     if ns.tcp and ns.socket:
         raise SystemExit("--tcp and --socket are mutually exclusive")
@@ -1566,6 +1613,87 @@ def _finalize_obs(rec) -> None:
               file=sys.stderr)
 
 
+def _add_exec_flags(sp, overlap: bool = True) -> None:
+    """Shared run/sweep/worker/serve compile-once surface (DESIGN.md
+    §23). Both default OFF and off is byte-identical to a build without
+    the exec-cache layer at all."""
+    sp.add_argument(
+        "--exec-cache", choices=("on", "off"), default="off",
+        help="consult/populate the on-disk AOT executable cache "
+             "($PRIMETPU_CACHE_DIR/exec): a warm process deserializes "
+             "the compiled program instead of paying trace+lower+XLA "
+             "compile; corrupt/stale entries degrade to recompile",
+    )
+    if overlap:
+        sp.add_argument(
+            "--overlap", choices=("on", "off"), default="off",
+            help="overlapped chunk dispatch: enqueue chunk k+1 before "
+                 "host-side durability work (journal fsync, checkpoint "
+                 "write, obs commit) so the device computes while the "
+                 "host syncs; bit-exact, chunked paths only",
+        )
+
+
+def _activate_exec_cache(ns):
+    """--exec-cache on -> the process-global cache (engines, supervisor
+    resume and serve buckets consult `exec_cache.active()`, so one flag
+    covers every compile site in the process)."""
+    from ..sim import exec_cache
+
+    if getattr(ns, "exec_cache", "off") == "on":
+        return exec_cache.configure(True)
+    return exec_cache.configure(False)
+
+
+def _emit_exec_cache_line(cache) -> None:
+    """The scriptable exec-cache record (CI parses hits/misses and
+    compile_wall_s from it; the structured fallback warnings ride in
+    detail). Printed only when --exec-cache on, keeping default-off
+    output byte-identical to the pre-cache CLI."""
+    if cache is None:
+        return
+    detail = dict(cache.stats)
+    detail["compile_wall_s"] = round(detail["compile_wall_s"], 3)
+    detail["load_wall_s"] = round(detail["load_wall_s"], 3)
+    if cache.warnings:
+        detail["warnings"] = cache.warnings
+    print(
+        json.dumps(
+            {
+                "metric": "exec_cache",
+                "value": detail["hits"],
+                "unit": "hits",
+                "detail": detail,
+            }
+        )
+    )
+
+
+def _emit_ttfs_line(cache, t_start: float) -> None:
+    """First-class time-to-first-step metric: wall time from command
+    entry until the first chunk has executed (the warm-up dispatch),
+    split into compile vs deserialize. Cold runs record a miss, warm
+    runs a hit with compile_wall_s ~ 0."""
+    if cache is None:
+        return
+    print(
+        json.dumps(
+            {
+                "metric": "time_to_first_step",
+                "value": round(time.perf_counter() - t_start, 3),
+                "unit": "s",
+                "detail": {
+                    "cold": cache.stats["misses"] > 0,
+                    "compile_wall_s": round(
+                        cache.stats["compile_wall_s"], 3
+                    ),
+                    "load_wall_s": round(cache.stats["load_wall_s"], 3),
+                },
+            }
+        )
+    )
+
+
 def _add_fault_flags(sp) -> None:
     """Shared run/sweep fault-injection surface (DESIGN.md §12)."""
     sp.add_argument(
@@ -1659,6 +1787,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_resilience_flags(r)
     _add_fault_flags(r)
     _add_obs_flags(r)
+    _add_exec_flags(r)
     r.set_defaults(fn=cmd_run)
 
     w = sub.add_parser(
@@ -1760,6 +1889,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_resilience_flags(w)
     _add_fault_flags(w)
     _add_obs_flags(w)
+    _add_exec_flags(w)
     w.set_defaults(fn=cmd_sweep)
 
     k = sub.add_parser(
@@ -1789,6 +1919,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="exit 0 after SEC seconds of continuous idle (no leases "
              "granted) — the elastic fleet's scale-down path",
     )
+    _add_exec_flags(k)
     k.set_defaults(fn=cmd_worker)
 
     co = sub.add_parser(
@@ -1964,6 +2095,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_fault_flags(v)
     _add_obs_flags(v)
+    # no --overlap: the serving tick splices/retires slots between
+    # chunks, so a speculated chunk would be invalidated every tick
+    _add_exec_flags(v, overlap=False)
     v.set_defaults(fn=cmd_serve)
 
     rp = sub.add_parser(

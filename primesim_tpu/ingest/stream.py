@@ -29,6 +29,7 @@ import numpy as np
 
 from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES, zero_counters
+from ..sim import exec_cache
 from ..sim.engine import _ACC_BITS, stream_loop
 from ..sim.state import init_state
 from ..trace.format import (
@@ -198,14 +199,17 @@ class StreamEngine:
         cannot desynchronize."""
         cfg = self.cfg
         buf, exhausted, filled = self._fill_window()
-        out = stream_loop(
-            cfg,
-            self._place_core_axis(buf),
-            self.state._replace(ptr=self._zero_ptr()),
-            self._place_core_axis(exhausted),
-            self._place_core_axis(filled),
-            jnp.asarray(0, jnp.int32),
-            has_sync=self.has_sync,
+        out = exec_cache.call(
+            stream_loop, "stream.loop",
+            (cfg,),
+            (
+                self._place_core_axis(buf),
+                self.state._replace(ptr=self._zero_ptr()),
+                self._place_core_axis(exhausted),
+                self._place_core_axis(filled),
+                jnp.asarray(0, jnp.int32),
+            ),
+            {"has_sync": self.has_sync},
         )
         np.asarray(out[0].cycles)  # block until compiled
 
@@ -220,14 +224,21 @@ class StreamEngine:
         buf, exhausted, filled = self._fill_window()
         t1 = time.perf_counter() if self.obs is not None else 0.0
         st = self.state._replace(ptr=self._zero_ptr())
-        out = stream_loop(
-            cfg,
-            self._place_core_axis(buf),
-            st,
-            self._place_core_axis(exhausted),
-            self._place_core_axis(filled),
-            jnp.asarray(min(budget, 2**31 - 1), jnp.int32),
-            has_sync=self.has_sync,
+        # NOTE: no overlapped dispatch here — the next window's input is
+        # produced by the host-side fill/absorb cycle itself (the very
+        # work overlap would hide), so there is nothing device-side to
+        # speculate. The exec cache still applies.
+        out = exec_cache.call(
+            stream_loop, "stream.loop",
+            (cfg,),
+            (
+                self._place_core_axis(buf),
+                st,
+                self._place_core_axis(exhausted),
+                self._place_core_axis(filled),
+                jnp.asarray(min(budget, 2**31 - 1), jnp.int32),
+            ),
+            {"has_sync": self.has_sync},
         )
         t2 = time.perf_counter() if self.obs is not None else 0.0
         k_int, consumed, at_end = absorb_stream_outputs(self, out, buf)

@@ -135,10 +135,12 @@ class PoolWorker:
         simulate_crash: bool = False,
         rng=None,
         idle_exit_s: float | None = None,
+        overlap: bool = False,
     ):
         self.socket_path = str(socket_path)
         self.worker_id = str(worker_id)
         self.warm_cache = bool(warm_cache)
+        self.overlap = bool(overlap)
         self.reconnect_timeout_s = float(reconnect_timeout_s)
         self.crash_after_chunks = crash_after_chunks
         self.simulate_crash = bool(simulate_crash)
@@ -343,6 +345,12 @@ class PoolWorker:
                 chunk_steps=int(unit["chunk_steps"]),
                 mesh=self._unit_mesh(unit, cfg),
             )
+        fleet.overlap = self.overlap
+        # AOT warm at lease grant (§23): with an exec cache active, pay
+        # deserialization (or compile-once) NOW, before the first chunk —
+        # the heartbeat from `grant` already covers this window, so a
+        # cache hit means compile never eats lease TTL
+        fleet.warm_exec()
 
         resumed_steps = 0
         if grant.get("checkpoint"):
@@ -523,6 +531,7 @@ def run_worker(
     reconnect_timeout_s: float = 60.0,
     crash_after_chunks: int | None = None,
     idle_exit_s: float | None = None,
+    overlap: bool = False,
 ) -> int:
     return PoolWorker(
         socket_path,
@@ -531,4 +540,5 @@ def run_worker(
         reconnect_timeout_s=reconnect_timeout_s,
         crash_after_chunks=crash_after_chunks,
         idle_exit_s=idle_exit_s,
+        overlap=overlap,
     ).run()

@@ -331,16 +331,20 @@ class DispatchScheduler:
         while len(self._workers) < want:
             self._worker_seq += 1
             wid = f"dw{self._worker_seq}"
-            proc = subprocess.Popen(
-                [
-                    sys.executable, "-m", "primesim_tpu.cli", "worker",
-                    "--connect", self.pool_socket,
-                    "--worker-id", wid,
-                    "--reconnect-timeout", str(self.lease_ttl_s * 6.0),
-                    "--idle-exit", "10",
-                ],
-                stdout=subprocess.DEVNULL,
-            )
+            argv = [
+                sys.executable, "-m", "primesim_tpu.cli", "worker",
+                "--connect", self.pool_socket,
+                "--worker-id", wid,
+                "--reconnect-timeout", str(self.lease_ttl_s * 6.0),
+                "--idle-exit", "10",
+            ]
+            # propagate `serve --exec-cache on` so autoscaled workers
+            # deserialize the fleet executable at lease grant (§23)
+            from ..sim import exec_cache
+
+            if exec_cache.active() is not None:
+                argv += ["--exec-cache", "on"]
+            proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL)
             self._workers.append(proc)
             self._serve_event("spawn_worker", worker=wid, pid=proc.pid)
 

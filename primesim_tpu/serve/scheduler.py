@@ -171,6 +171,10 @@ class SlotBucket:
             self.cfg, self.n_slots, self.capacity,
             chunk_steps=self.chunk_steps,
         )
+        # AOT warm (§23): with `serve --exec-cache on` the bucket's
+        # chunk executable deserializes from disk instead of compiling
+        # on the first dispatch tick. No-op when the cache is inactive.
+        fleet.warm_exec()
         if self.obs is not None:
             # per-bucket timeline row: the recorder keys counter deltas
             # by label, so each bucket diffs against its own history

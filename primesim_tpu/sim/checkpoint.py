@@ -749,32 +749,42 @@ def prune_warm_cache(root: str, max_bytes: int | None = None) -> int:
     """Evict least-recently-used entries until the cache fits under
     `max_bytes` (default $PRIMETPU_CACHE_MAX_BYTES or 2 GiB). Returns the
     number of entries removed. Hits refresh mtime, so mtime order IS use
-    order."""
+    order.
+
+    The budget is SHARED with the executable cache (§23): warm `.npz`
+    entries in `root` and AOT `.bin` entries in `root/exec` form one
+    LRU pool, so a burst of geometry sweeps can evict stale executables
+    and vice versa — one knob bounds the whole cache tree."""
     if max_bytes is None:
         max_bytes = int(
             os.environ.get("PRIMETPU_CACHE_MAX_BYTES", _WARM_DEFAULT_MAX_BYTES)
         )
     entries = []
-    try:
-        names = os.listdir(root)
-    except OSError:
-        return 0
-    for name in names:
-        if not name.endswith(".npz"):
-            continue
-        path = os.path.join(root, name)
+    pools = [(root, ".npz")]
+    exec_root = os.path.join(root, "exec")
+    if os.path.isdir(exec_root):
+        pools.append((exec_root, ".bin"))
+    for pool_root, suffix in pools:
         try:
-            st = os.stat(path)
+            names = os.listdir(pool_root)
         except OSError:
             continue
-        entries.append((st.st_mtime, st.st_size, path))
+        for name in names:
+            if not name.endswith(suffix):
+                continue
+            path = os.path.join(pool_root, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, path, suffix))
     total = sum(e[1] for e in entries)
-    entries.sort()  # oldest first
+    entries.sort()  # oldest first across BOTH pools
     removed = 0
-    for mtime, size, path in entries:
+    for mtime, size, path, suffix in entries:
         if total <= max_bytes:
             break
-        for victim in (path, path[: -len(".npz")] + ".json"):
+        for victim in (path, path[: -len(suffix)] + ".json"):
             try:
                 os.unlink(victim)
             except OSError:

@@ -47,6 +47,7 @@ from ..trace.format import (
     EV_UNLOCK,
     Trace,
 )
+from . import exec_cache
 from .state import (
     E,
     I,
@@ -2016,6 +2017,16 @@ class Engine:
         # snapshot rather than run from step 0
         self.prefix_steps = 0
         self.prefix_cache_key = None
+        # overlapped chunk dispatch (§23): when True, run_steps enqueues
+        # chunk k+1 from the just-committed state before returning, so the
+        # caller's host-side durability work (journal fsync, checkpoint
+        # write, obs commit) runs concurrently with device compute.
+        # _pending holds (source_state, dispatched_result, chunk_steps);
+        # validity is the OBJECT IDENTITY of source_state — any rollback,
+        # checkpoint load or restore reassigns self.state and thereby
+        # invalidates the speculation automatically.
+        self.overlap = False
+        self._pending = None
 
     def _drain(self) -> None:
         cnt = _np(self.state.counters)
@@ -2096,13 +2107,11 @@ class Engine:
         to chunk_steps-1 extra steps may execute before the guard trips.
         """
         max_chunks = -(-max_steps // self.chunk_steps)
-        st, acc_lo, acc_hi, base_lo, base_hi, k = run_loop(
-            self.cfg,
-            self.chunk_steps,
-            self.events,
-            self.state,
-            jnp.asarray(max_chunks, jnp.int32),
-            has_sync=self.has_sync,
+        st, acc_lo, acc_hi, base_lo, base_hi, k = exec_cache.call(
+            run_loop, "engine.run_loop",
+            (self.cfg, self.chunk_steps),
+            (self.events, self.state, jnp.asarray(max_chunks, jnp.int32)),
+            {"has_sync": self.has_sync},
         )
         # one synchronizing transfer for everything the host needs
         acc_lo = _np(acc_lo).astype(np.int64)
@@ -2140,36 +2149,73 @@ class Engine:
         target = self.steps_run + n_steps
         while self.steps_run < target and not self.done():
             if self.obs is None:
-                self.state = run_chunk(
-                    self.cfg, self.chunk_steps, self.events, self.state,
-                    has_sync=self.has_sync,
-                )
+                self._dispatch_chunk()
                 self.steps_run += self.chunk_steps
                 self._drain()
                 self._rebase()
+                if self.overlap and not self.done():
+                    self._prefetch_chunk()
             else:
                 # phase cuts: dispatch is the async enqueue; drain's
                 # host transfer synchronizes, so "drain" includes the
                 # device executing the chunk; rebase is pure host work
                 t0 = time.perf_counter()
-                self.state = run_chunk(
-                    self.cfg, self.chunk_steps, self.events, self.state,
-                    has_sync=self.has_sync,
-                )
+                self._dispatch_chunk()
                 t1 = time.perf_counter()
                 self.steps_run += self.chunk_steps
                 self._drain()
                 t2 = time.perf_counter()
                 self._rebase()
                 t3 = time.perf_counter()
+                phases = {"dispatch": t1 - t0, "drain": t2 - t1,
+                          "rebase": t3 - t2}
+                if self.overlap and not self.done():
+                    self._prefetch_chunk()
+                    phases["prefetch"] = time.perf_counter() - t3
                 self.obs.chunk_committed(
                     self.obs_label, self.chunk_steps, t3 - t0,
-                    self.host_counters,
-                    phases={"dispatch": t1 - t0, "drain": t2 - t1,
-                            "rebase": t3 - t2},
+                    self.host_counters, phases=phases,
                 )
             if debug_invariants:
                 self.verify_invariants()
+
+    def _dispatch_chunk(self) -> None:
+        """Advance self.state by one chunk: consume the prefetched result
+        when it was speculated from EXACTLY this state object at this
+        chunk size, else dispatch now (through the exec cache when one is
+        active)."""
+        pend, self._pending = self._pending, None
+        if (
+            pend is not None
+            and pend[0] is self.state
+            and pend[2] == self.chunk_steps
+        ):
+            self.state = pend[1]
+            return
+        self.state = exec_cache.call(
+            run_chunk, "engine.run_chunk",
+            (self.cfg, self.chunk_steps), (self.events, self.state),
+            {"has_sync": self.has_sync},
+        )
+
+    def _prefetch_chunk(self) -> None:
+        """Overlap prong (§23): enqueue chunk k+1 from the committed
+        state. JAX's async dispatch returns immediately; the device works
+        while the host does durability. The result is NOT committed here
+        — _dispatch_chunk adopts it only if the committed state is still
+        the same object it was speculated from."""
+        src = self.state
+        nxt = exec_cache.call(
+            run_chunk, "engine.run_chunk",
+            (self.cfg, self.chunk_steps), (self.events, src),
+            {"has_sync": self.has_sync},
+        )
+        self._pending = (src, nxt, self.chunk_steps)
+
+    def discard_prefetch(self) -> None:
+        """Drop any speculated chunk (state surgery makes it moot; the
+        identity check would reject it anyway — this just frees it)."""
+        self._pending = None
 
     def block_until_ready(self) -> None:
         """Synchronize the engine's async device uploads (events + the

@@ -45,6 +45,7 @@ import numpy as np
 from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES
 from ..trace.format import EV_BARRIER, EV_END, EV_LOCK, EV_UNLOCK, Trace
+from . import exec_cache
 from .engine import _ACC_BITS, _np, run_chunk, run_loop
 from .state import MachineState, init_state
 
@@ -307,6 +308,13 @@ class FleetEngine:
         self.mesh = mesh
         if mesh is not None:
             self._reshard()
+        # overlapped chunk dispatch (§23), mirroring Engine: speculate
+        # chunk k+1 from the committed state before the caller's host-side
+        # durability work; identity of the source state object validates
+        # the speculation (element surgery / restore / reshard all
+        # reassign self.state, invalidating it automatically)
+        self.overlap = False
+        self._pending = None
 
     def _reshard(self) -> None:
         """Re-place events and state on the fleet mesh layout. Called at
@@ -407,13 +415,11 @@ class FleetEngine:
     def run(self, max_steps: int = 10_000_000) -> None:
         """Run every element to completion in ONE device dispatch."""
         max_chunks = -(-max_steps // self.chunk_steps)
-        st, acc_lo, acc_hi, base_lo, base_hi, k = fleet_run_loop(
-            self.geom_cfg,
-            self.chunk_steps,
-            self.events,
-            self.state,
-            jnp.asarray(max_chunks, jnp.int32),
-            has_sync=self.has_sync,
+        st, acc_lo, acc_hi, base_lo, base_hi, k = exec_cache.call(
+            fleet_run_loop, "fleet.run_loop",
+            (self.geom_cfg, self.chunk_steps),
+            (self.events, self.state, jnp.asarray(max_chunks, jnp.int32)),
+            {"has_sync": self.has_sync},
         )
         acc_lo = _np(acc_lo).astype(np.int64)  # [B, n_counters, C]
         acc_hi = _np(acc_hi).astype(np.int64)
@@ -450,38 +456,76 @@ class FleetEngine:
         (shared by run_steps and the serving tick's step_chunk)."""
         live = ~self.done_mask()
         if self.obs is None:
-            self.state = fleet_run_chunk(
-                self.geom_cfg,
-                self.chunk_steps,
-                self.events,
-                self.state,
-                has_sync=self.has_sync,
-            )
+            self._dispatch_chunk()
             self.steps_run += np.where(live, self.chunk_steps, 0)
             self._drain()
             self._rebase()
+            if self.overlap and not self.done():
+                self._prefetch_chunk()
             return
         # phase cuts mirror Engine.run_steps: dispatch = async enqueue,
         # drain = synchronizing transfer (includes device execution),
         # rebase = host clock bookkeeping
         t0 = time.perf_counter()
-        self.state = fleet_run_chunk(
-            self.geom_cfg,
-            self.chunk_steps,
-            self.events,
-            self.state,
-            has_sync=self.has_sync,
-        )
+        self._dispatch_chunk()
         t1 = time.perf_counter()
         self.steps_run += np.where(live, self.chunk_steps, 0)
         self._drain()
         t2 = time.perf_counter()
         self._rebase()
         t3 = time.perf_counter()
+        phases = {"dispatch": t1 - t0, "drain": t2 - t1, "rebase": t3 - t2}
+        if self.overlap and not self.done():
+            self._prefetch_chunk()
+            phases["prefetch"] = time.perf_counter() - t3
         self.obs.chunk_committed(
             self.obs_label, self.chunk_steps, t3 - t0, self.host_counters,
-            phases={"dispatch": t1 - t0, "drain": t2 - t1,
-                    "rebase": t3 - t2},
+            phases=phases,
+        )
+
+    def _dispatch_chunk(self) -> None:
+        """Advance self.state by one chunk, consuming the prefetched
+        result when it was speculated from exactly this state object at
+        this chunk size (Engine._dispatch_chunk, batched)."""
+        pend, self._pending = self._pending, None
+        if (
+            pend is not None
+            and pend[0] is self.state
+            and pend[2] == self.chunk_steps
+        ):
+            self.state = pend[1]
+            return
+        self.state = exec_cache.call(
+            fleet_run_chunk, "fleet.run_chunk",
+            (self.geom_cfg, self.chunk_steps), (self.events, self.state),
+            {"has_sync": self.has_sync},
+        )
+
+    def _prefetch_chunk(self) -> None:
+        src = self.state
+        nxt = exec_cache.call(
+            fleet_run_chunk, "fleet.run_chunk",
+            (self.geom_cfg, self.chunk_steps), (self.events, src),
+            {"has_sync": self.has_sync},
+        )
+        self._pending = (src, nxt, self.chunk_steps)
+
+    def discard_prefetch(self) -> None:
+        self._pending = None
+
+    def warm_exec(self) -> bool:
+        """Load-or-compile this fleet's chunk executable through the
+        active exec cache WITHOUT running it — the pool worker calls this
+        at lease grant so a cache hit pays deserialization (not XLA
+        compile) before the first chunk, and compile never eats lease
+        TTL. No-op (False) when no cache is active."""
+        cache = exec_cache.active()
+        if cache is None:
+            return False
+        return cache.ensure(
+            fleet_run_chunk, "fleet.run_chunk",
+            (self.geom_cfg, self.chunk_steps), (self.events, self.state),
+            {"has_sync": self.has_sync},
         )
 
     def block_until_ready(self) -> None:

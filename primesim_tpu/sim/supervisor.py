@@ -407,6 +407,9 @@ class RunSupervisor:
         eng.host_counters = snap["host_counters"]
         if self.kind == "stream":
             eng.cursor = snap["cursor"]
+        # any overlapped speculation was made from a state we just rolled
+        # away from; the identity check would reject it, this frees it
+        getattr(eng, "discard_prefetch", lambda: None)()
 
     def _advance_chunk(self, budget_left: int) -> int:
         """Advance the engine by one committed chunk; returns steps run

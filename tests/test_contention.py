@@ -171,6 +171,7 @@ def test_golden_link_model_disjoint_paths_free():
 @pytest.mark.parametrize(
     "gen", ["false_sharing", "lock_contention", "barrier_phases"]
 )
+@pytest.mark.slow
 def test_parity_link_model(gen):
     cfg = small_test_config(
         8, n_banks=4, quantum=300,
@@ -185,6 +186,7 @@ def test_parity_link_model(gen):
     assert_parity(cfg, tr, chunk_steps=50)
 
 
+@pytest.mark.slow
 def test_parity_link_model_16core_hot_path():
     # many cores streaming through the same mesh column: heavy shared-link
     # occupancy, engine and golden must agree bit-exactly

@@ -63,6 +63,7 @@ def test_controller_clock_carries_across_steps():
 @pytest.mark.parametrize(
     "gen", ["false_sharing", "uniform_random", "barrier_phases"]
 )
+@pytest.mark.slow
 def test_parity_dram_queue(gen):
     cfg = qcfg(8, n_banks=4)
     tr = {
@@ -73,6 +74,7 @@ def test_parity_dram_queue(gen):
     assert_parity(cfg, tr, chunk_steps=50)
 
 
+@pytest.mark.slow
 def test_parity_dram_queue_with_router_and_runs():
     # all the timing models stacked: hop-by-hop router + controller
     # queue + local runs + O3 — still bit-exact

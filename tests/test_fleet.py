@@ -120,6 +120,7 @@ def test_fleet_parity_contention_and_dram_queue_knobs():
         )
 
 
+@pytest.mark.slow
 def test_fleet_parity_router_model():
     # the router NoC model's link_free clocks rebase per element with a
     # per-element quantum — the hairiest drain/rebase interaction
@@ -148,6 +149,7 @@ def test_fleet_parity_router_model():
         )
 
 
+@pytest.mark.slow
 def test_fleet_one_compilation_per_geometry():
     # changing only TRACED timing knobs between fleet runs must not
     # retrigger compilation; changing geometry must
@@ -192,6 +194,7 @@ def test_fleet_rejections():
         apply_overrides(cfg, {"quantum": 2**30})
 
 
+@pytest.mark.slow
 def test_fleet_uneven_lengths_and_early_finish():
     # elements finishing chunks apart: the short element must freeze
     # bit-exactly while the long one keeps the fleet's while_loop live

@@ -184,6 +184,7 @@ def test_parity_non_pow2_cores():
     assert_parity(cfg, GENS["false_sharing"](12))
 
 
+@pytest.mark.slow
 def test_parity_folded_traces():
     # fold_ins moves INS batches into mem events' pre field (pre > 0 paths);
     # golden and engine must stay bit-exact on the folded representation

@@ -68,6 +68,7 @@ def test_parity_256core_eight_sharer_words():
     assert_parity(cfg, tr, chunk_steps=80)
 
 
+@pytest.mark.slow
 def test_parity_256core_false_sharing_local_runs():
     cfg = scale_machine(256, 16, 16, local_run_len=4)
     assert_parity(

@@ -205,6 +205,7 @@ def test_forked_seed_sweep_bit_exact_vs_unforked():
         )
 
 
+@pytest.mark.slow
 def test_forked_mixed_groups_and_singletons_bit_exact():
     # two prefix-sharing classes (different traces) plus a knob-override
     # singleton that is NOT forked — all coexisting in one fleet
@@ -439,6 +440,7 @@ def _elem_lines(lines):
     return out
 
 
+@pytest.mark.slow
 def test_cli_sweep_fork_and_warm_cache(tmp_path, capsys, monkeypatch):
     from primesim_tpu.cli import main
 

@@ -56,6 +56,7 @@ def test_router_plus_dram_queue_parity():
     assert_xla_pallas_match(cfg, tr, chunk_steps=32)
 
 
+@pytest.mark.slow
 def test_router_local_runs_and_larger_mesh():
     # rl > 0 composes (deferred run patches change t0 inputs), and a
     # 4x4 mesh exercises H = 6 hop columns with multi-block cores
@@ -71,6 +72,7 @@ def test_router_local_runs_and_larger_mesh():
     assert_xla_pallas_match(cfg, tr, chunk_steps=32)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "events",
     [
@@ -91,6 +93,7 @@ def test_router_fault_detours_compose_with_kernel(events):
     assert_xla_pallas_match(cfg, tr, chunk_steps=32)
 
 
+@pytest.mark.slow
 def test_fleet_vmapped_router_kernel_bit_exact_vs_solo():
     # the fleet vmaps the whole step including the cascade kernel: per
     # element results must equal solo runs bit-for-bit, with traced knob
@@ -115,6 +118,7 @@ def test_fleet_vmapped_router_kernel_bit_exact_vs_solo():
         )
 
 
+@pytest.mark.slow
 def test_fleet_faulted_router_replay_solo_vs_vmapped():
     # chaos acceptance: faults-on router runs replay bit-exactly solo vs
     # fleet-vmapped through the kernel (counters included)

@@ -80,6 +80,7 @@ def test_three_way_parity_every_generator(gen):
     assert_xla_pallas_match(cfg, tr, chunk_steps=32)
 
 
+@pytest.mark.slow
 def test_parity_local_runs():
     # rl > 0: the kernels take the deferred run-patch masks (hm/wm/cm)
     # as extra inputs — probe applies them, commit writes them back
@@ -89,6 +90,7 @@ def test_parity_local_runs():
     assert_xla_pallas_match(cfg, tr)
 
 
+@pytest.mark.slow
 def test_parity_folded_trace():
     from primesim_tpu.trace.format import fold_ins
 
@@ -98,6 +100,7 @@ def test_parity_folded_trace():
     assert_xla_pallas_match(cfg, tr)
 
 
+@pytest.mark.slow
 def test_parity_coarse_directory():
     # sharer_group > 1: group-granular sharer words + the epoch planes'
     # validation guard, both inside the kernels
@@ -107,6 +110,7 @@ def test_parity_coarse_directory():
     assert_xla_pallas_match(cfg, tr)
 
 
+@pytest.mark.slow
 def test_parity_router_noc_and_dram_queue():
     # cross-step queue state (link_free / dram_free) composes with the
     # kernels: phases 1/4 are fused, phase 3's queueing stays XLA
@@ -148,6 +152,7 @@ def test_parity_64core_multiblock():
     assert_xla_pallas_match(cfg, tr, chunk_steps=32)
 
 
+@pytest.mark.slow
 def test_fleet_vmapped_pallas_step():
     # the fleet vmaps the whole step: the kernels must batch correctly
     # (no pl.program_id — core ids are data), with per-element traced
@@ -194,6 +199,7 @@ def test_fleet_vmapped_pallas_step():
             )
 
 
+@pytest.mark.slow
 def test_fleet_vmapped_pallas_coarse():
     # coarse directory under the vmapped kernels (sharer_group is part
     # of the geometry key, shared by every element)

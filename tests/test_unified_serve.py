@@ -372,6 +372,7 @@ def test_window_promotion_bit_exact(tmp_path):
         assert j.result["counters"] == ctr
 
 
+@pytest.mark.slow
 def test_demotion_unblocks_queued_job_bit_exact(tmp_path):
     """A small job squatting in the big bucket is DEMOTED into a free
     small slot when a queued job fits nowhere else — both finish
