@@ -30,7 +30,10 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh).
 `PRIMETPU_BENCH_COLDSTART=0` skips the cold_start_speedup measurement
 (the shipped rung-3 config through two fresh `--exec-cache on`
 subprocesses against one cache dir: compile wall bought vs deserialize
-wall paid, DESIGN.md §23).
+wall paid, DESIGN.md §23). `PRIMETPU_BENCH_ATTEST=0` skips the
+attest_overhead_pct measurement (the per-chunk fingerprint chain vs
+the same chunked dispatch with attest off, DESIGN.md §24; advisory
+gate < 3%).
 
 Rung-3 knobs: `PRIMETPU_BENCH_RUNG3=0` skips the rung-3 measurement;
 `PRIMETPU_BENCH_RUNG3_FLOOR=<mips>` makes the regression gate HARD
@@ -449,6 +452,64 @@ def main() -> None:
             "passed": bool(obs_overhead_pct < 3.0),
         }
 
+    # result-integrity contract (DESIGN.md §24): the per-chunk sha256
+    # fingerprint chain vs the identical chunked dispatch with attest
+    # off — the chain hashes host values the drain already transferred,
+    # so the cost is one digest per committed chunk. Advisory at < 3%
+    # like obs (host-timer noise on shared runners). PRIMETPU_BENCH_ATTEST=0
+    # skips (metric and gate report null).
+    attest_detail = None
+    attest_gate = None
+    if os.environ.get("PRIMETPU_BENCH_ATTEST", "1") != "0":
+        from primesim_tpu.attest import SoloAttest
+        from primesim_tpu.sim.engine import Engine, run_chunk
+
+        AT_CHUNK = 64
+        at_trace = fold_ins(
+            synth.fft_like(
+                C, n_phases=2, points_per_core=64, ins_per_mem=8, seed=43
+            )
+        )
+        warm_a = Engine(cfg, at_trace, chunk_steps=AT_CHUNK)
+        out_a = run_chunk(
+            cfg, AT_CHUNK, warm_a.events, warm_a.state,
+            has_sync=warm_a.has_sync,
+        )
+        np.asarray(out_a.cycles)  # block until compiled
+
+        def _attest_wall(on: bool, runs: int = 3):
+            best, chunks, head = None, 0, None
+            for _ in range(runs):
+                e = Engine(cfg, at_trace, chunk_steps=AT_CHUNK)
+                if on:
+                    e.attest = SoloAttest(AT_CHUNK)
+                e.block_until_ready()
+                t0 = time.perf_counter()
+                e.run_chunked(max_steps=10_000_000)
+                w = time.perf_counter() - t0
+                best = w if best is None else min(best, w)
+                chunks = e.steps_run // AT_CHUNK
+                if on:
+                    head = e.attest.payload()["head"]
+            return best, chunks, head
+
+        wall_plain, at_chunks, _ = _attest_wall(False)
+        wall_chain, _, at_head = _attest_wall(True)
+        attest_overhead_pct = (wall_chain - wall_plain) / wall_plain * 100.0
+        attest_detail = {
+            "chunks": int(at_chunks),
+            "chunk_steps": AT_CHUNK,
+            "wall_s_attest_off": round(wall_plain, 4),
+            "wall_s_attest_chain": round(wall_chain, 4),
+            "chain_head": at_head,
+            "overhead_pct": round(attest_overhead_pct, 2),
+        }
+        attest_gate = {
+            "floor_pct": 3.0,
+            "hard": False,
+            "passed": bool(attest_overhead_pct < 3.0),
+        }
+
     # LIVE per-phase cuts (scripts/prof/prof_phase.py source surgery) on
     # elastic pool scaling (DESIGN.md §17): the same 16-element campaign
     # through `sweep --workers 1` vs `--workers 3` — real worker
@@ -730,6 +791,13 @@ def main() -> None:
                     "cold_start_speedup": (
                         cold_detail["speedup_x"] if cold_detail else None
                     ),
+                    # per-chunk fingerprint-chain wall cost over the
+                    # same chunked dispatch with attest off (null when
+                    # PRIMETPU_BENCH_ATTEST=0; advisory gate < 3%)
+                    "attest_overhead_pct": (
+                        attest_detail["overhead_pct"]
+                        if attest_detail else None
+                    ),
                 },
                 "detail": {
                     "n_cores": C,
@@ -762,6 +830,12 @@ def main() -> None:
                     # chunked dispatch (null when PRIMETPU_BENCH_OBS=0)
                     "obs_overhead": obs_detail,
                     "obs_overhead_gate": obs_gate,
+                    # result-integrity overhead contract (DESIGN.md
+                    # §24): the fingerprint chain at --attest chain vs
+                    # attest off on the same chunked dispatch (null
+                    # when PRIMETPU_BENCH_ATTEST=0)
+                    "attest_overhead": attest_detail,
+                    "attest_overhead_gate": attest_gate,
                     # aggregate MIPS batching B sims through one program
                     # (rung-1/64-core config, one distinct trace per
                     # element)

@@ -49,7 +49,11 @@ from .errors import FsckCorrupt
 
 _JOURNAL_ACTIVE = "journal.jsonl"
 _SERVE_TYPES = {"accept", "state"}
-_POOL_TYPES = {"unit", "lease", "expire", "ack", "poison"}
+_POOL_TYPES = {"unit", "lease", "expire", "ack", "poison",
+               "ack_dup", "suspect", "verdict", "audit"}
+# pool record types that may carry a fingerprint-chain payload
+# (DESIGN.md §24), directly or inside a `held` evidence list
+_ATTEST_TYPES = {"ack", "ack_dup", "suspect", "verdict"}
 
 
 @dataclasses.dataclass
@@ -435,8 +439,140 @@ def _check_pool_records(records: list, rel_dir: str) -> list:
                     corrupt=True,
                 ))
             note_key(uid, stamped, "unit spec")
-        elif t in ("lease", "ack", "poison"):
+        elif t in ("lease", "ack", "poison", "ack_dup", "suspect",
+                   "verdict"):
             note_key(str(rec.get("unit_id", "?")), rec.get("key"), t)
+    return findings
+
+
+# ---- attestation records (DESIGN.md §24) -------------------------------
+
+
+def _attest_shape(at) -> str:
+    """'' when `at` is a well-formed chain payload, else what's wrong."""
+    if not isinstance(at, dict):
+        return f"payload is {type(at).__name__}, not a dict"
+    head = at.get("head")
+    if not (isinstance(head, str) and len(head) == 64
+            and all(c in "0123456789abcdef" for c in head)):
+        return "head is not a 64-hex sha256 digest"
+    for field, lo in (("chunks", 1), ("start", 0), ("chunk_steps", 1)):
+        v = at.get(field)
+        if not isinstance(v, int) or isinstance(v, bool) or v < lo:
+            return f"{field} is not an int >= {lo}"
+    return ""
+
+
+def _check_attest_records(records: list, rel_dir: str,
+                          dirpath: str, root: str) -> list:
+    """Attestation-record legality: payload shapes, ack->suspect chain
+    continuity, suspect->verdict ordering, and static ack-vs-checkpoint
+    agreement against the unit's surviving units/<uid>.npz. Purely
+    structural — `primetpu audit` is the dynamic (re-execution) half."""
+    findings: list = []
+    last_ack: dict = {}       # unit_id -> attest of the winning ack
+    open_suspect: set = set()  # units with a held divergence pending
+
+    def bad(uid: str, t: str, why: str):
+        findings.append(Finding(
+            "attest-record", rel_dir,
+            f"unit {uid}: {t} record carries a malformed chain payload "
+            f"({why})", corrupt=True,
+        ))
+
+    for rec in records:
+        t = rec.get("t")
+        if t not in _ATTEST_TYPES and t != "audit":
+            continue
+        uid = str(rec.get("unit_id", "?"))
+        at = rec.get("attest")
+        if at is not None:
+            why = _attest_shape(at)
+            if why:
+                bad(uid, t, why)
+                at = None
+        for h in (rec.get("held") or []):
+            ha = h.get("attest") if isinstance(h, dict) else None
+            if ha is not None:
+                why = _attest_shape(ha)
+                if why:
+                    bad(uid, f"{t}.held", why)
+        if t == "ack":
+            last_ack[uid] = at
+        elif t == "suspect":
+            held = rec.get("held") or []
+            prior = last_ack.get(uid)
+            if prior is not None and held:
+                first = held[0].get("attest") \
+                    if isinstance(held[0], dict) else None
+                if first != prior:
+                    findings.append(Finding(
+                        "attest-record", rel_dir,
+                        f"unit {uid}: suspect record's first held "
+                        "payload is not the chain the preceding ack "
+                        "journaled — retained evidence was rewritten",
+                        corrupt=True,
+                    ))
+            open_suspect.add(uid)
+            last_ack.pop(uid, None)
+        elif t == "verdict":
+            if uid not in open_suspect:
+                findings.append(Finding(
+                    "attest-record", rel_dir,
+                    f"unit {uid}: verdict record with no preceding "
+                    "suspect record in the chain — a tiebreak for a "
+                    "divergence nobody journaled", corrupt=True,
+                ))
+            open_suspect.discard(uid)
+            if rec.get("outcome") == "resolved":
+                last_ack[uid] = at
+        elif t == "audit" and uid not in last_ack \
+                and uid not in open_suspect:
+            findings.append(Finding(
+                "attest-record", rel_dir,
+                f"unit {uid}: audit record for a unit with no acked "
+                "result in the chain", corrupt=True,
+            ))
+
+    # static ack-vs-checkpoint agreement: a surviving unit checkpoint
+    # must be a plausible PREFIX of the acked chain — same cadence and
+    # origin, no more chunks than the ack, identical head when equal
+    for uid, at in sorted(last_ack.items()):
+        if at is None:
+            continue
+        path = os.path.join(dirpath, "units", f"{uid}.npz")
+        if not os.path.isfile(path):
+            continue
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            from ..sim.checkpoint import _attest_from, load_verified_npz
+
+            ca = _attest_from(load_verified_npz(path))
+        except Exception:  # noqa: BLE001 — _check_npz owns that finding
+            continue
+        if not (ca and ca.get("head")) or _attest_shape(ca):
+            continue
+        if (int(ca["start"]) != int(at["start"])
+                or int(ca["chunk_steps"]) != int(at["chunk_steps"])):
+            continue  # resumed/halved cadence — incomparable, not wrong
+        if int(ca["chunks"]) > int(at["chunks"]):
+            findings.append(Finding(
+                "attest-checkpoint", rel,
+                f"unit {uid}: checkpoint chain claims "
+                f"{int(ca['chunks'])} chunk(s) but the acked result "
+                f"committed only {int(at['chunks'])} — the checkpoint "
+                "holds progress past the journaled truth",
+                corrupt=True, repairable=True,
+            ))
+        elif int(ca["chunks"]) == int(at["chunks"]) \
+                and ca["head"] != at["head"]:
+            findings.append(Finding(
+                "attest-checkpoint", rel,
+                f"unit {uid}: checkpoint chain head disagrees with the "
+                "acked result at the same chunk count — one of them "
+                "was not produced by the committed execution",
+                corrupt=True, repairable=True,
+            ))
     return findings
 
 
@@ -694,6 +830,9 @@ def run_fsck(root: str, repair: str = "none") -> FsckResult:
                 findings.extend(_check_serve_records(records, rel_dir))
             if types & _POOL_TYPES:
                 findings.extend(_check_pool_records(records, rel_dir))
+            if types & (_ATTEST_TYPES | {"audit"}):
+                findings.extend(_check_attest_records(
+                    records, rel_dir, dirpath, root))
         for name in sorted(names - journal_files):
             path = os.path.join(dirpath, name)
             rel = os.path.relpath(path, root).replace(os.sep, "/")

@@ -1,0 +1,25 @@
+"""Result attestation: fingerprint chains, ACK cross-checks, audits.
+
+See DESIGN.md §24. Public surface:
+
+- `SoloAttest` / `FleetAttest` — per-chunk chain holders the engines
+  call at every committed chunk boundary (dead-branch off by default:
+  engines hold `self.attest = None` and never touch state).
+- `AttestChain`, `chunk_digest`, `comparable`, `heads_equal` — the
+  chain primitives.
+- `toolchain_fingerprint` / `toolchain_matches` — lease-time worker
+  toolchain verification (reuses the exec-cache key fields).
+- `AttestationError` — typed error on the CLI's exit-2 contract.
+- `audit` module — offline re-execution audit (`primetpu audit`).
+"""
+
+from .chain import (AttestChain, FleetAttest, SoloAttest, chunk_digest,
+                    comparable, heads_equal, link, toolchain_fingerprint,
+                    toolchain_matches)
+from .errors import AttestationError
+
+__all__ = [
+    "AttestChain", "FleetAttest", "SoloAttest", "chunk_digest",
+    "comparable", "heads_equal", "link", "toolchain_fingerprint",
+    "toolchain_matches", "AttestationError",
+]

@@ -1,0 +1,241 @@
+"""Per-chunk fingerprint chains over committed simulator state.
+
+The chunked loop already materializes everything a verifier needs at
+every commit point: the drained `host_counters`, the rebased
+`cycle_base`, `steps_run`, and the `MachineState` pytree itself. A
+fingerprint is a single SHA-256 over those values in a fixed layout;
+chaining folds each chunk's fingerprint into a running head
+(`head_{k} = H(head_{k-1} || digest_k)`), so two executions agree on
+the final head iff they agreed on *every* committed chunk. Because the
+simulator is bit-exact across solo/fleet/sharded execution (DESIGN
+§10/§16/§22), the chain is a checkable cross-worker invariant: a
+silently-wrong worker (bad DIMM, miscompiled kernel, mismatched
+jaxlib) produces a different head, not a plausible-looking result.
+
+Everything here is pure host-side numpy on data the loop already
+holds; engines keep `self.attest = None` by default and never touch
+state when it is off, so `--attest off` is bit-exact trivially.
+
+Chain payloads are small dicts `{head, chunks, start, chunk_steps}`.
+Two payloads are *comparable* only when `start` and `chunk_steps`
+agree — a warm-forked run (chain starts at the prefix boundary) or an
+OOM-halved chunk cadence produces a different but equally valid chain,
+which must never be treated as divergence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+from ..stats.counters import COUNTER_NAMES
+
+# Domain tag: bump if the digest layout ever changes, so heads from
+# different layouts can never collide as "equal".
+_DOMAIN = b"ptattest1"
+
+GENESIS = ""
+
+
+def chunk_digest(steps_run: int, cycle_base: int, host_counters: dict,
+                 leaves: list, cursor: int | None = None) -> str:
+    """Fingerprint one committed chunk: counters + state leaves in a
+    fixed order. `leaves` is the tree-flattened `MachineState` (host
+    numpy arrays); `cursor` joins only for stream engines, whose chain
+    is window-based and scoped to the stream run."""
+    h = hashlib.sha256(_DOMAIN)
+    h.update(np.int64(steps_run).tobytes())
+    h.update(np.int64(cycle_base).tobytes())
+    if cursor is not None:
+        # stream engines: per-core window cursors join the cut
+        h.update(np.ascontiguousarray(
+            np.asarray(cursor, dtype=np.int64)).tobytes())
+    for name in COUNTER_NAMES:
+        arr = np.ascontiguousarray(np.asarray(host_counters[name],
+                                              dtype=np.int64))
+        h.update(arr.tobytes())
+    for leaf in leaves:
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def link(prev_head: str, digest: str) -> str:
+    return hashlib.sha256(
+        _DOMAIN + prev_head.encode() + digest.encode()).hexdigest()
+
+
+def comparable(a: dict | None, b: dict | None) -> bool:
+    """Two chain payloads can be meaningfully compared only when they
+    cover the same steps from the same starting boundary at the same
+    chunk cadence."""
+    if not a or not b or not a.get("head") or not b.get("head"):
+        return False
+    return (int(a.get("start", 0)) == int(b.get("start", 0))
+            and int(a.get("chunk_steps", 0)) == int(b.get("chunk_steps", 0)))
+
+
+def heads_equal(a: dict, b: dict) -> bool:
+    return (a.get("head") == b.get("head")
+            and int(a.get("chunks", -1)) == int(b.get("chunks", -2)))
+
+
+class AttestChain:
+    """One engine's (or fleet element's) running fingerprint chain."""
+
+    __slots__ = ("head", "chunks", "start", "chunk_steps")
+
+    def __init__(self, chunk_steps: int, *, start: int = 0,
+                 head: str = GENESIS, chunks: int = 0):
+        self.chunk_steps = int(chunk_steps)
+        self.start = int(start)
+        self.head = str(head)
+        self.chunks = int(chunks)
+
+    def update(self, digest: str) -> str:
+        self.head = link(self.head, digest)
+        self.chunks += 1
+        return self.head
+
+    def payload(self) -> dict:
+        return {"head": self.head, "chunks": self.chunks,
+                "start": self.start, "chunk_steps": self.chunk_steps}
+
+    def snapshot(self) -> tuple:
+        return (self.head, self.chunks)
+
+    def restore(self, snap: tuple) -> None:
+        self.head, self.chunks = str(snap[0]), int(snap[1])
+
+    @classmethod
+    def from_payload(cls, p: dict) -> "AttestChain":
+        return cls(p.get("chunk_steps", 0), start=p.get("start", 0),
+                   head=p.get("head", GENESIS), chunks=p.get("chunks", 0))
+
+    def note_cadence(self, chunk_steps: int) -> None:
+        """The supervisor OOM-halved the chunk cadence mid-run: the
+        chain stays internally valid but is no longer comparable to a
+        full-cadence execution — recording the new cadence here makes
+        `comparable()` say so instead of reporting a false mismatch."""
+        self.chunk_steps = int(chunk_steps)
+
+
+def _host_leaves(state) -> list:
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(state)]
+
+
+class SoloAttest:
+    """Chain holder for a solo (or stream) engine. The engine calls
+    `observe(self)` once per committed chunk from `run_steps` /
+    `_advance_window`; everything read is already on the host."""
+
+    def __init__(self, chunk_steps: int, *, start: int = 0,
+                 head: str = GENESIS, chunks: int = 0):
+        self.chain = AttestChain(chunk_steps, start=start, head=head,
+                                 chunks=chunks)
+
+    def observe(self, eng) -> None:
+        d = chunk_digest(int(eng.steps_run), int(eng.cycle_base),
+                         eng.host_counters, _host_leaves(eng.state),
+                         cursor=getattr(eng, "cursor", None))
+        self.chain.update(d)
+
+    def payload(self) -> dict:
+        return self.chain.payload()
+
+    def snapshot(self) -> tuple:
+        return self.chain.snapshot()
+
+    def restore(self, snap: tuple) -> None:
+        self.chain.restore(snap)
+
+    def seed(self, payload: dict | None, fallback_start: int = 0) -> None:
+        """Continue a checkpointed chain, or — for a pre-attestation
+        checkpoint with no chain members — start a fresh chain whose
+        coverage begins at the checkpoint's step count."""
+        if payload and payload.get("head"):
+            self.chain = AttestChain.from_payload(payload)
+        else:
+            self.chain = AttestChain(self.chain.chunk_steps,
+                                     start=int(fallback_start))
+
+    def note_cadence(self, chunk_steps: int) -> None:
+        self.chain.note_cadence(chunk_steps)
+
+
+class FleetAttest:
+    """Per-element chains for a FleetEngine. Only tracked slots hash;
+    only elements *live at chunk start* advance their chain — finished
+    elements keep stepping in the batched program (their `state.step`
+    moves) but their chain stops exactly where the solo engine's loop
+    would have stopped, which is what makes fleet heads comparable to
+    solo heads."""
+
+    def __init__(self):
+        self.chains: dict[int, AttestChain] = {}
+
+    def track(self, i: int, chunk_steps: int, *, start: int = 0,
+              head: str = GENESIS, chunks: int = 0) -> AttestChain:
+        ch = AttestChain(chunk_steps, start=start, head=head,
+                         chunks=chunks)
+        self.chains[int(i)] = ch
+        return ch
+
+    def drop(self, i: int) -> None:
+        self.chains.pop(int(i), None)
+
+    def chain(self, i: int) -> AttestChain | None:
+        return self.chains.get(int(i))
+
+    def payload(self, i: int) -> dict | None:
+        ch = self.chains.get(int(i))
+        return None if ch is None else ch.payload()
+
+    def observe(self, fleet, live) -> None:
+        if not self.chains:
+            return
+        live = np.asarray(live)
+        leaves = _host_leaves(fleet.state)
+        for i, ch in self.chains.items():
+            if not bool(live[i]):
+                continue
+            counters = {k: fleet.host_counters[k][i]
+                        for k in COUNTER_NAMES}
+            d = chunk_digest(int(fleet.steps_run[i]),
+                             int(fleet.cycle_base[i]), counters,
+                             [leaf[i] for leaf in leaves])
+            ch.update(d)
+
+    def snapshot(self) -> dict:
+        return {i: ch.snapshot() for i, ch in self.chains.items()}
+
+    def restore(self, snap: dict) -> None:
+        for i, s in snap.items():
+            ch = self.chains.get(i)
+            if ch is not None:
+                ch.restore(s)
+
+    def note_cadence(self, chunk_steps: int) -> None:
+        for ch in self.chains.values():
+            ch.note_cadence(chunk_steps)
+
+
+def toolchain_fingerprint() -> dict:
+    """The toolchain fields a lease grant verifies before letting a
+    worker compute anything — the same jax/jaxlib/backend triple the
+    exec-cache key embeds (`exec_cache.exec_key_payload`), so "same
+    toolchain" here means "would deserialize the same executable"."""
+    return {
+        "jax": str(jax.__version__),
+        "jaxlib": str(jax.lib.__version__),
+        "backend": str(jax.default_backend()),
+    }
+
+
+def toolchain_matches(ours: dict, theirs: dict) -> str:
+    """Return '' when compatible, else the first mismatched field."""
+    for k in ("jax", "jaxlib", "backend"):
+        if str(theirs.get(k, "")) != str(ours.get(k, "")):
+            return k
+    return ""

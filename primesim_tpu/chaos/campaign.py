@@ -65,6 +65,13 @@ SERVE_SITES = {
 #: exercise catch-up or promotion-under-loss.
 REPLICATION_SITES = ("replicate.send", "replica.pre-fsync-ack")
 
+#: Silent-data-corruption sites: the attestation trial (DESIGN.md §24).
+#: Opt-in via `--classes silent_corruption` and routed to their OWN
+#: trial — a flip in a serve-trial fleet would be undetectable by
+#: construction (that is the whole point of attestation) and would read
+#: as a bogus invariant-B violation there.
+ATTEST_SITES = ("fleet.counters", "checkpoint.payload")
+
 #: Small deterministic workloads (serve's synth grammar). Distinct seeds
 #: give distinct results, so a cross-wired job table fails invariant B.
 DEFAULT_SPECS = (
@@ -697,6 +704,179 @@ def run_replication_trial(
                        injected=injected, restarts=restarts)
 
 
+# ---- the attestation trial (silent corruption vs the fingerprint chain) --
+
+_ATTEST_DEADLINE_S = 300.0
+_ATTEST_WORKERS = 4  # headroom: every resolved mismatch quarantines one
+
+#: fault-free pooled reference, memoized across a campaign's trials
+_attest_golden_memo: dict = {}
+
+
+def _canon_pool(rec) -> str:
+    """`_canon` for pool unit records: additionally drop the attest
+    payload (golden runs attest-off, so chains exist only on one side)
+    and the suspects list (bookkeeping, not simulation output)."""
+
+    def strip(obj):
+        if isinstance(obj, dict):
+            return {k: strip(v) for k, v in sorted(obj.items())
+                    if k not in _NONDET_KEYS + ("attest", "suspects")}
+        if isinstance(obj, list):
+            return [strip(v) for v in obj]
+        return obj
+
+    return json.dumps(strip(rec), sort_keys=True)
+
+
+def _pool_drain(root, cfg, specs, attest, audit_rate,
+                n_workers=_ATTEST_WORKERS):
+    """One pooled campaign, in-process: coordinator over a real socket,
+    worker THREADS sharing this process's chaos runtime (so a plan's
+    flip events land inside worker executions). Returns (results,
+    counters, suspect_workers)."""
+    import threading
+    import time as _time
+
+    from ..pool import PoolCoordinator, PoolWorker
+    from ..pool.units import build_units
+
+    units = build_units(
+        cfg, [], list(specs), [{} for _ in specs],
+        fold=True, chunk_steps=16, max_steps=100_000,
+    )
+    coord = PoolCoordinator(
+        units, root, lease_ttl_s=30.0, hedge=False,
+        attest=attest, audit_rate=audit_rate,
+    )
+    coord.start()
+    try:
+        threads = [
+            threading.Thread(
+                target=PoolWorker(coord.socket_path, f"w{k}",
+                                  reconnect_timeout_s=10.0).run,
+                daemon=True,
+            )
+            for k in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        deadline = _time.monotonic() + _ATTEST_DEADLINE_S
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - _time.monotonic()))
+        results = coord.results()
+        counters = dict(coord.counters)
+        suspects = set(coord.suspect_workers)
+    finally:
+        coord.close(drained=coord.done)
+    return results, counters, suspects
+
+
+def attest_golden_run(cfg=None, specs=DEFAULT_SPECS,
+                      workdir: str | None = None) -> dict:
+    """Fault-free pooled reference for invariant F: index -> canonical
+    unit result, attest OFF (the trial's attest-on results must strip
+    down to exactly these bytes)."""
+    cfg = cfg or _default_cfg()
+    key = (cfg.to_json(), tuple(specs))
+    hit = _attest_golden_memo.get(key)
+    if hit is not None:
+        return hit
+    assert sites.runtime() is None, "golden run must be fault-free"
+    tmp = tempfile.mkdtemp(prefix="chaos-attest-golden-", dir=workdir)
+    try:
+        results, _counters, _suspects = _pool_drain(
+            tmp, cfg, specs, attest="off", audit_rate=0.0, n_workers=1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    out = {}
+    for r in results:
+        if r["state"] != "DONE":
+            raise RuntimeError(
+                f"attest golden run: unit {r['unit_id']} ended "
+                f"{r['state']}, want DONE"
+            )
+        out[r["index"]] = _canon_pool(r["result"])
+    _attest_golden_memo[key] = out
+    return out
+
+
+def run_attest_trial(
+    plan: P.FaultPlan,
+    cfg=None,
+    specs=DEFAULT_SPECS,
+    golden: dict | None = None,
+    workdir: str | None = None,
+    keep_dir: bool = False,
+) -> TrialResult:
+    """One seeded trial of the result-integrity story (DESIGN.md §24):
+    a pooled campaign with `--attest chain --audit-rate 1.0` under a
+    plan of silent-corruption flips, then machine-check
+
+      F. NO CORRUPTED RESULT DONE-UNFLAGGED — every unit that ends DONE
+         carries the fault-free golden result; a corrupted execution
+         must have been voided (tiebreak re-run) or ended SUSPECT.
+
+    plus the false-positive dual: a trial where NO flip fired must show
+    zero mismatches, zero SUSPECT units and zero quarantined workers."""
+    cfg = cfg or _default_cfg()
+    # `golden` is the serve-shaped reference run_campaign threads
+    # through every trial; the pooled reference is its own shape and is
+    # memoized per (config, specs) in attest_golden_run
+    del golden
+    ref = attest_golden_run(cfg, specs, workdir=workdir)
+    tmp = tempfile.mkdtemp(prefix="chaos-attest-", dir=workdir)
+    violations: list = []
+    rt = sites.install(plan, mode="raise")
+    try:
+        results, counters, suspects = _pool_drain(
+            tmp, cfg, specs, attest="chain", audit_rate=1.0)
+        injected = list(rt.injected)
+    finally:
+        sites.deactivate()
+
+    fired_flips = [e for e in injected if e["site"] in ATTEST_SITES]
+    flagged = 0
+    for r in results:
+        want = ref.get(r["index"])
+        if r["state"] == "DONE":
+            if want is not None and _canon_pool(r["result"]) != want:
+                violations.append(
+                    f"invariant F: unit {r['unit_id']} is DONE with a "
+                    f"result diverging from golden and no flag (got "
+                    f"{_canon_pool(r['result'])[:200]}... want "
+                    f"{want[:200]}...)"
+                )
+        elif r["state"] == "SUSPECT":
+            flagged += 1
+            if not fired_flips:
+                violations.append(
+                    f"false positive: unit {r['unit_id']} ended SUSPECT "
+                    "with no corruption injected"
+                )
+        else:
+            violations.append(
+                f"attest trial did not converge: unit {r['unit_id']} "
+                f"ended {r['state']}"
+            )
+    if not fired_flips:
+        if counters.get("attest_mismatches", 0):
+            violations.append(
+                "false positive: "
+                f"{counters['attest_mismatches']} chain mismatch(es) "
+                "with no corruption injected"
+            )
+        if suspects:
+            violations.append(
+                f"false positive: workers {sorted(suspects)} quarantined "
+                "with no corruption injected"
+            )
+    if not keep_dir:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return TrialResult(plan=plan, violations=violations,
+                       injected=injected)
+
+
 # ---- the campaign --------------------------------------------------------
 
 
@@ -711,6 +891,8 @@ def _trial_sites(classes) -> tuple[list, set]:
             socket_only.add(cls)
     if "replication" in classes:
         names.extend(REPLICATION_SITES)
+    if "silent_corruption" in classes:
+        names.extend(ATTEST_SITES)
     return names, socket_only
 
 
@@ -738,6 +920,13 @@ def run_trial(plan, cfg=None, specs=DEFAULT_SPECS, golden=None,
     ):
         return run_replication_trial(plan, cfg=cfg, specs=specs,
                                      golden=golden, workdir=workdir, **kw)
+    if plan.events and any(
+        e.site in ATTEST_SITES for e in plan.events
+    ):
+        # a flip in a serve-trial fleet would be an undetectable bogus
+        # invariant-B failure; corruption plans get the attested pool
+        return run_attest_trial(plan, cfg=cfg, specs=specs,
+                                golden=golden, workdir=workdir, **kw)
     if plan.events and all(
         sites.SITES.get(e.site) == "socket" for e in plan.events
     ):
@@ -762,7 +951,10 @@ def run_campaign(
     1-minimal event set and write a replayable repro artifact. Returns
     the campaign report (the `primetpu chaos` JSON surface)."""
     cfg = cfg or _default_cfg()
-    golden = golden_run(cfg, specs, workdir=workdir)
+    # a pure silent_corruption campaign never runs a serve trial, so
+    # its serve-shaped golden would be wasted work
+    golden = (golden_run(cfg, specs, workdir=workdir)
+              if any(c != "silent_corruption" for c in classes) else None)
     site_pool, _ = _trial_sites(classes)
     report = {
         "trials": 0, "violations": [], "fired_events": 0,

@@ -28,6 +28,7 @@ ACTIONS = {
     "crashpoint": ("kill",),
     "clock": ("skew",),
     "replication": ("partition", "delay", "duplicate"),
+    "silent_corruption": ("flip",),
 }
 
 # recv-side sockets can only lose or delay the reply — tearing or
@@ -121,6 +122,12 @@ def _event_args(rng: random.Random, action: str) -> tuple:
         return (("s", round(rng.uniform(0.05, 0.4), 3)),)
     if action == "skew":
         return (("offset_s", round(rng.uniform(0.5, 30.0), 3)),)
+    if action == "flip":
+        # which array (modulo the dict size), which element (modulo its
+        # flat size), and a guaranteed-nonzero perturbation
+        return (("key", rng.randint(0, 7)),
+                ("pos", rng.randint(0, 1 << 16)),
+                ("delta", rng.choice((-3, -1, 1, 2, 5, 17)),))
     return ()
 
 

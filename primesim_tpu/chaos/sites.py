@@ -58,6 +58,11 @@ SITES = {
     # replication stream (primary -> replica orders; serve/replicate.py)
     "replicate.send": "replication",
     "replica.pre-fsync-ack": "crashpoint",
+    # silent-data-corruption sites (DESIGN.md §24): perturb committed
+    # values in place with NO crash — the worker hashes and ACKs the
+    # wrong data, and only attestation cross-checks can tell
+    "fleet.counters": "silent_corruption",      # sim/fleet.py post-drain
+    "checkpoint.payload": "silent_corruption",  # element checkpoint arrays
 }
 
 ENV_PLAN = "PRIMETPU_CHAOS_PLAN"  # path to a FaultPlan JSON file
@@ -289,6 +294,26 @@ def clock_skew(site: str, value: float) -> float:
             _RT.clock_offsets.get(site, 0.0) + float(ev.arg("offset_s", 1.0))
         )
     return value + _RT.clock_offsets.get(site, 0.0)
+
+
+def corrupt(site: str, arrays: dict) -> bool:
+    """Silent-corruption site (DESIGN.md §24): perturb one committed
+    int64 value in one of `arrays` (a dict of writable host numpy
+    arrays), in place, with NO crash and NO error — the caller proceeds
+    to fingerprint, checkpoint and ACK the wrong data exactly like a
+    machine with a flaky DIMM would. Detection is attestation's job
+    (invariant F), not this hook's. Returns True when a flip fired."""
+    if _RT is None:
+        return False
+    ev = _RT.hit(site)
+    if ev is None or ev.action != "flip" or not arrays:
+        return False
+    keys = sorted(arrays)
+    arr = arrays[keys[int(ev.arg("key", 0)) % len(keys)]]
+    flat = arr.reshape(-1)
+    delta = int(ev.arg("delta", 1)) or 1
+    flat[int(ev.arg("pos", 0)) % flat.size] += delta
+    return True
 
 
 def wrap_clock(site: str, clock):

@@ -209,9 +209,12 @@ def _run_supervised(ns, cfg, eng, rec=None) -> int:
         _finalize_obs(rec)  # the flight recorder survives preemption
         return _emit_preempted(e, sup)
     wall = time.perf_counter() - t0
+    extra = sup.summary()
+    if getattr(eng, "attest", None) is not None:
+        extra["attest"] = eng.attest.payload()
     _emit_summary(
         ns, cfg, ns.engine, eng.counters, eng.cycles, wall,
-        extra=sup.summary(), resilience=sup.log_lines(),
+        extra=extra, resilience=sup.log_lines(),
         timeline=rec.timeline_summary() if rec is not None else None,
     )
     _finalize_obs(rec)
@@ -391,6 +394,12 @@ def cmd_run(ns) -> int:
             "--obs does not compose with --xprof (pick the flight "
             "recorder OR the XLA profiler for a given run)"
         )
+    attest_on = getattr(ns, "attest", "off") == "chain"
+    if attest_on and ns.engine == "golden":
+        raise SystemExit(
+            "--attest requires --engine jax (the chain fingerprints "
+            "committed chunk state; the golden oracle has no chunk loop)"
+        )
 
     if ns.engine == "golden":
         if (
@@ -426,6 +435,12 @@ def cmd_run(ns) -> int:
             return _run_pipelined_cli(ns, cfg, tr, mesh, rec)
         eng = StreamEngine(cfg, tr, window_events=ns.stream_window,
                            mesh=mesh)
+        if attest_on:
+            # window-scoped chain: the stream engine's natural chunk is
+            # the window, so the cadence field is the window size
+            from ..attest import SoloAttest
+
+            eng.attest = SoloAttest(ns.stream_window)
         if overlap:
             print(
                 "overlap: the stream engine's next window is produced by "
@@ -467,7 +482,7 @@ def cmd_run(ns) -> int:
         warm = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
         from ..sim import exec_cache
 
-        if ns.debug_invariants or supervised or rec is not None:
+        if ns.debug_invariants or supervised or rec is not None or attest_on:
             # the chunked paths (debug + supervised run_steps) dispatch
             # run_chunk, not the fused run_loop — warm what will run
             # (routed through the exec cache so a warm process pays
@@ -489,6 +504,10 @@ def cmd_run(ns) -> int:
         _emit_ttfs_line(cache, t_start)
         eng = Engine(cfg, tr, chunk_steps=ns.chunk_steps, mesh=mesh)
         eng.overlap = overlap
+        if attest_on:
+            from ..attest import SoloAttest
+
+            eng.attest = SoloAttest(ns.chunk_steps)
         eng.block_until_ready()  # don't bill async uploads to simulation
         if supervised:
             rc = _run_supervised(ns, cfg, eng, rec=rec)
@@ -498,7 +517,7 @@ def cmd_run(ns) -> int:
             rec.attach(eng)
 
         def _go():
-            if ns.debug_invariants or rec is not None:
+            if ns.debug_invariants or rec is not None or attest_on:
                 # chunked dispatch: host visibility at every chunk is
                 # what the telemetry (and the invariant checks) need
                 eng.run_chunked(
@@ -520,6 +539,7 @@ def cmd_run(ns) -> int:
 
     _emit_summary(
         ns, cfg, ns.engine, counters, cycles, wall,
+        extra={"attest": eng.attest.payload()} if attest_on else None,
         timeline=rec.timeline_summary() if rec is not None else None,
     )
     _emit_exec_cache_line(cache)
@@ -1078,6 +1098,8 @@ def cmd_coordinator(ns) -> int:
         hedge=ns.hedge == "on",
         obs=rec,
         dynamic=True,
+        attest=getattr(ns, "attest", "off") or "off",
+        audit_rate=float(getattr(ns, "audit_rate", 0.0) or 0.0),
     )
     try:
         coord.start()
@@ -1178,6 +1200,36 @@ def cmd_fsck(ns) -> int:
             f"{len(res.corrupt)} corrupt artifact finding(s) under "
             f"{where} (first: {first.path}: {first.detail})",
             path=first.path, n_corrupt=len(res.corrupt),
+        )
+    return 0
+
+
+def cmd_audit(ns) -> int:
+    """Offline replay audit (DESIGN.md §24): re-execute a pool
+    campaign's DONE units from their journaled specs and compare the
+    recomputed fingerprint-chain heads against the ledger's acked
+    heads, its retained hedged-twin/held evidence, and the surviving
+    element checkpoints. Works on a kill -9'd pool dir — the ledger is
+    read with fsck's read-only reader, nothing is mutated."""
+    from ..attest.audit import run_audit
+    from ..attest.errors import AttestationError
+
+    res = run_audit(ns.dir, unit_ids=ns.unit)
+    for v in res["units"]:
+        print(json.dumps(v))
+    s = res["summary"]
+    print(
+        f"audit: {s['audited']} unit(s) replayed — {s['ok']} ok, "
+        f"{s['mismatch']} mismatch, {s['adjudicated']} adjudicated, "
+        f"{s['incomparable']} incomparable, {s['skipped']} skipped",
+        file=sys.stderr,
+    )
+    if s["mismatch"]:
+        first = next(v for v in res["units"] if v["status"] == "mismatch")
+        raise AttestationError(
+            f"{s['mismatch']} unit(s) fail offline replay audit under "
+            f"{ns.dir} (first: {first['unit_id']})",
+            site="audit.replay", unit=first["unit_id"],
         )
     return 0
 
@@ -1304,6 +1356,8 @@ def cmd_serve(ns) -> int:
         quorum=ns.quorum,
         quorum_policy=ns.quorum_policy,
         devices=getattr(ns, "devices", 0) or 0,
+        attest=getattr(ns, "attest", "off") or "off",
+        audit_rate=float(getattr(ns, "audit_rate", 0.0) or 0.0),
     )
     # bind before the readiness line so `--tcp HOST:0` prints the real
     # kernel-assigned port (tests and scripts scrape this line)
@@ -1708,6 +1762,23 @@ def _add_fault_flags(sp) -> None:
     )
 
 
+def _add_attest_flags(sp, audit: bool = True) -> None:
+    sp.add_argument(
+        "--attest", choices=("off", "chain"), default="off",
+        help="result integrity (DESIGN.md §24): fingerprint-chain every "
+             "committed chunk, compare hedged-twin results instead of "
+             "discarding the loser, and verify worker toolchains at "
+             "lease grant (default off — bit-exact with today)",
+    )
+    if audit:
+        sp.add_argument(
+            "--audit-rate", type=float, default=0.0, metavar="P",
+            help="(--attest chain) re-dispatch this fraction of DONE "
+                 "units to a different worker and compare chain heads "
+                 "(deterministic per-unit sampling; default 0)",
+        )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="primetpu",
@@ -1788,6 +1859,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_fault_flags(r)
     _add_obs_flags(r)
     _add_exec_flags(r)
+    _add_attest_flags(r, audit=False)
     r.set_defaults(fn=cmd_run)
 
     w = sub.add_parser(
@@ -1886,6 +1958,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--report", metavar="PATH",
         help="(--workers) write a text report with the POOL section",
     )
+    _add_attest_flags(w)
     _add_resilience_flags(w)
     _add_fault_flags(w)
     _add_obs_flags(w)
@@ -1950,6 +2023,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--hedge", choices=("on", "off"), default="on",
         help="duplicate the straggler unit on idle workers (default on)",
     )
+    _add_attest_flags(co)
     _add_obs_flags(co)
     co.set_defaults(fn=cmd_coordinator)
 
@@ -2093,6 +2167,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="--standby-of: how long the primary must stay dead before "
              "promotion (default 3.0)",
     )
+    _add_attest_flags(v)
     _add_fault_flags(v)
     _add_obs_flags(v)
     # no --overlap: the serving tick splices/retires slots between
@@ -2239,6 +2314,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     fk.set_defaults(fn=cmd_fsck)
 
+    au = sub.add_parser(
+        "audit",
+        help="offline replay audit of a pool directory (DESIGN.md §24): "
+             "re-execute DONE units from their journaled specs and "
+             "compare fingerprint-chain heads against the ledger and "
+             "the surviving checkpoints; exit 2 with structured JSON on "
+             "divergence",
+    )
+    au.add_argument(
+        "dir", metavar="DIR",
+        help="pool directory (unit ledger + element checkpoints)",
+    )
+    au.add_argument(
+        "--unit", action="append", metavar="ID",
+        help="audit only this unit id (repeatable; default: every "
+             "replayable unit)",
+    )
+    au.set_defaults(fn=cmd_audit)
+
     ch = sub.add_parser(
         "chaos",
         help="seeded crash campaign over the serve stack: generate "
@@ -2257,10 +2351,12 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument(
         "--classes", default="durable,crashpoint",
         help="comma list of fault classes to draw from: durable, "
-             "crashpoint, socket, replication (default "
-             "durable,crashpoint; replication runs the primary+"
+             "crashpoint, socket, replication, silent_corruption "
+             "(default durable,crashpoint; replication runs the primary+"
              "replicas+standby failover trial and implies replica-kill "
-             "crashpoints)",
+             "crashpoints; silent_corruption flips committed counter "
+             "bits on a pooled attested campaign and checks that no "
+             "corrupted result reaches DONE unflagged)",
     )
     ch.add_argument(
         "--max-events", type=int, default=3,
@@ -2294,6 +2390,7 @@ def main(argv=None) -> int:
     install_from_env()
     ns = build_parser().parse_args(argv)
     from ..analysis.errors import AnalysisError, FsckCorrupt
+    from ..attest.errors import AttestationError
     from ..config.machine import FaultConfigError
     from ..parallel.sharding import DeviceMeshError
     from ..sim.checkpoint import CheckpointCorrupt
@@ -2302,7 +2399,8 @@ def main(argv=None) -> int:
     try:
         return ns.fn(ns)
     except (TraceError, FaultConfigError, CheckpointCorrupt, VarySpecError,
-            AnalysisError, FsckCorrupt, DeviceMeshError) as e:
+            AnalysisError, FsckCorrupt, DeviceMeshError,
+            AttestationError) as e:
         # typed errors exit 2 with ONE structured JSON line on stderr —
         # {"error": {type, location, detail}} — the same shape the serve
         # protocol and sweep quarantine lines use, so scripts parse one

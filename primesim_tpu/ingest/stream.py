@@ -137,6 +137,11 @@ class StreamEngine:
         # branch in _advance_window
         self.obs = None
         self.obs_label = "stream"
+        # attestation chain (attest.SoloAttest) — window-scoped: the
+        # stream engine's natural chunk is the WINDOW, so its chain is
+        # comparable only to another streamed run of the same trace
+        # (DESIGN.md §24); None = never fingerprint
+        self.attest = None
 
     def _fill_window(self):
         from ..trace.format import EV_LD, EV_LOCK, EV_ST, EV_UNLOCK
@@ -252,6 +257,8 @@ class StreamEngine:
                 phases={"fill": t1 - t0, "dispatch": t2 - t1,
                         "absorb": t3 - t2},
             )
+        if self.attest is not None:
+            self.attest.observe(self)
         finished = bool((at_end & exhausted).all())
         if not finished and k_int == 0 and not consumed.any():
             raise RuntimeError(

@@ -22,6 +22,7 @@ from .units import (
     LEASED,
     PENDING,
     POISON,
+    SUSPECT,
     build_units,
     fold_unit_records,
     unit_key,
@@ -53,6 +54,7 @@ __all__ = [
     "LeaseLost",
     "PENDING",
     "POISON",
+    "SUSPECT",
     "PoolCoordinator",
     "PoolWorker",
     "SimulatedCrash",

@@ -34,7 +34,7 @@ import tempfile
 import time
 
 from .coordinator import PoolCoordinator
-from .units import DONE, POISON, build_units
+from .units import DONE, POISON, SUSPECT, build_units
 
 
 def _fan_sources(ns):
@@ -153,6 +153,8 @@ def run_pooled_sweep(ns, cfg) -> int:
         poison_threshold=ns.poison_threshold,
         hedge=ns.hedge == "on",
         obs=rec,
+        attest=getattr(ns, "attest", "off") or "off",
+        audit_rate=float(getattr(ns, "audit_rate", 0.0) or 0.0),
     )
     if coord.recovered["results_adopted"]:
         print(
@@ -226,6 +228,29 @@ def _emit_campaign(ns, cfg, coord, wall, rec, finalize_obs,
             else:
                 casualties += 1  # worker-side quarantine
             print(json.dumps(line))
+        elif r["state"] == SUSPECT:
+            # distinct from poison: the results diverged under
+            # attestation and the tiebreak could not adjudicate — the
+            # held evidence stays in the pool ledger for `primetpu
+            # audit` / fsck
+            casualties += 1
+            print(json.dumps({
+                "metric": "suspect",
+                "value": None,
+                "unit": None,
+                "detail": {
+                    "engine": "fleet",
+                    "fleet_index": r["index"],
+                    "unit_id": r["unit_id"],
+                    "status": "suspect",
+                    "workers": r["suspects"],
+                    "detail": (
+                        "attested results diverged and a tiebreak did "
+                        "not adjudicate; all held payloads are in the "
+                        "pool ledger"
+                    ),
+                },
+            }))
         elif r["state"] == POISON:
             casualties += 1
             print(json.dumps({

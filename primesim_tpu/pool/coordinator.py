@@ -30,9 +30,20 @@ a "lost" worker that was merely slow still contributes its result.
 
 Hedging: when PENDING runs dry but leases remain in flight, a lease
 request is answered with a SPECULATIVE twin of the oldest single-leased
-unit (epoch bumped). First ack wins; the loser's ack folds away as a
-duplicate. This bounds campaign tail latency by a straggler's margin
-over the second-slowest worker rather than by the straggler itself.
+unit (epoch bumped). First ack wins; the loser's ack is RETAINED in the
+ledger (`ack_dup`, full payload) rather than discarded.
+
+Attestation (`attest="chain"`, DESIGN.md §24): ack records carry the
+worker's per-chunk fingerprint chain head, and the coordinator CHECKS
+rather than discards every duplicate — a hedged twin whose chain
+disagrees with the winner's voids the result, holds both payloads, and
+re-runs the unit fresh on a third worker as tiebreaker; whichever held
+worker the tiebreak refutes is quarantined (refused all future leases)
+under the SUSPECT state, distinct from poison. Lease grants also verify
+the worker's toolchain fields (jax/jaxlib/backend — the exec-cache key
+triple) so a wrong-toolchain worker is refused before computing
+anything, and `audit_rate=p` re-dispatches a deterministic fraction of
+DONE units to a different worker for sampled re-execution audit.
 """
 
 from __future__ import annotations
@@ -66,6 +77,8 @@ class PoolCoordinator:
         obs=None,
         clock=time.monotonic,
         dynamic: bool = False,
+        attest: str = "off",
+        audit_rate: float = 0.0,
     ):
         self.pool_dir = str(pool_dir)
         os.makedirs(os.path.join(self.pool_dir, "units"), exist_ok=True)
@@ -100,12 +113,38 @@ class PoolCoordinator:
             "leases": 0, "expired": 0, "redispatches": 0, "hedges": 0,
             "acks": 0, "duplicates": 0, "poisoned": 0, "heartbeats": 0,
             "readoptions": 0, "enqueued": 0,
+            # attestation (DESIGN.md §24)
+            "attest_confirms": 0, "attest_mismatches": 0,
+            "attest_incomparable": 0, "suspects": 0, "verdicts": 0,
+            "audits": 0, "audits_ok": 0, "toolchain_refused": 0,
         }
+        if attest not in ("off", "chain"):
+            from ..attest import AttestationError
+            raise AttestationError(
+                f"attest must be off|chain, got {attest!r}",
+                site="coordinator.init",
+            )
+        self.attest_mode = str(attest)
+        self.audit_rate = float(audit_rate)
+        # workers a tiebreak refuted: refused every future lease
+        self.suspect_workers: set[str] = set()
+        # unit_id -> sampled re-execution audit bookkeeping
+        self.audits: dict[str, dict] = {}
+        self._toolchain = None  # lazy reference triple (attest on only)
         # per-client round-robin bookkeeping for the QoS lease pick
         self._last_pick: dict[str, int] = {}
         self._pick_n = 0
         self.recovered = self._recover()
         self._srv = None
+        if self.attest_mode != "off" and not self.dynamic:
+            # offline audit (`primetpu audit`) replays units from the
+            # ledger alone — journal each classic-campaign spec once so
+            # a kill -9'd pool dir is self-describing (dynamic mode
+            # already journals specs at enqueue)
+            for uid, u in self.units.items():
+                if uid not in self._spec_journaled:
+                    self.journal.append({"t": "unit", "unit": u["spec"]})
+                    self._spec_journaled.add(uid)
 
     @staticmethod
     def _entry(spec: dict) -> dict:
@@ -118,6 +157,13 @@ class PoolCoordinator:
             "kills": set(),
             "result": None,
             "resumed_steps": 0,
+            # attestation (§24): the authoritative ack's chain payload
+            # and worker, payloads held across a divergence, and workers
+            # barred from re-running THIS unit (the divergent pair)
+            "attest": None,
+            "ack_worker": None,
+            "held": [],
+            "suspects": set(),
         }
 
     # ---- restart recovery ------------------------------------------------
@@ -131,13 +177,22 @@ class PoolCoordinator:
         records, dropped = self.journal.replay()
         # first pass: re-create dynamically enqueued units from their
         # journaled specs (a kill -9'd coordinator has no campaign list
-        # to hand back in — the ledger IS the unit table)
+        # to hand back in — the ledger IS the unit table), remember which
+        # specs are already on record, and re-adopt worker quarantines
         respawned = 0
+        self._spec_journaled: set[str] = set()
         for rec in records:
-            if rec.get("t") != "unit":
+            t = rec.get("t")
+            if t == "verdict":
+                self.suspect_workers |= {
+                    str(w) for w in rec.get("quarantined", [])}
+                continue
+            if t != "unit":
                 continue
             spec = rec.get("unit") or {}
             uid = str(spec.get("unit_id", ""))
+            if uid:
+                self._spec_journaled.add(uid)
             if uid and uid not in self.units:
                 self.units[uid] = self._entry(spec)
                 respawned += 1
@@ -153,13 +208,30 @@ class PoolCoordinator:
                 continue
             u["epoch"] = max(u["epoch"], f["max_epoch"])
             u["kills"] |= f["kills"]
+            u["suspects"] |= f["suspects"]
+            u["held"] = list(f["held"])
             if f["result"] is not None:
                 u["state"] = U.DONE
                 u["result"] = f["result"]
                 u["resumed_steps"] = f["resumed_steps"]
+                u["attest"] = f["attest"]
+                u["ack_worker"] = f["ack_worker"]
                 adopted += 1
+                if self._audit_due(u) and not f["audits"]:
+                    # the sample decision is a pure function of the unit
+                    # key, so a restart re-derives exactly the audits
+                    # that had not yet completed
+                    self.audits[unit_id] = {
+                        "state": "pending", "worker": None, "epoch": 0,
+                        "orig": str(f["ack_worker"] or ""),
+                        "deadline": 0.0, "tried": set(),
+                    }
+            elif f["suspect"] == "terminal":
+                u["state"] = U.SUSPECT
             elif f["poison"]:
                 u["state"] = U.POISON
+            # f["suspect"] == "pending" stays PENDING: the tiebreak
+            # re-dispatch survives a coordinator restart via u["held"]
         stats = {
             "ledger_records": len(records),
             "torn_tail_dropped": dropped,
@@ -203,6 +275,13 @@ class PoolCoordinator:
                                      kills=len(u["kills"]))
                 else:
                     u["state"] = U.PENDING  # re-dispatch on next lease
+        for unit_id, a in self.audits.items():
+            if a["state"] == "leased" and a["deadline"] < now:
+                # audit worker went quiet: back to pending, and let the
+                # same worker retry later (liveness over strictness)
+                a["tried"].discard(a["worker"])
+                a["state"] = "pending"
+                a["worker"] = None
 
     def _checkpoint_rel(self, unit_id: str) -> str | None:
         rel = os.path.join("units", f"{unit_id}.npz")
@@ -239,7 +318,7 @@ class PoolCoordinator:
             "hedge" if hedge else ("redispatch" if redispatch else "lease"),
             unit=unit_id, worker=worker, epoch=u["epoch"],
         )
-        return {
+        grant = {
             "ok": True,
             "unit": u["spec"],
             "epoch": u["epoch"],
@@ -248,6 +327,16 @@ class PoolCoordinator:
             "pool_dir": self.pool_dir,
             "hedge": hedge,
         }
+        if self.attest_mode != "off":
+            grant["attest"] = self.attest_mode
+        if u["held"]:
+            # tiebreak re-run after a divergence: no checkpoint resume,
+            # no warm fork — the third chain must be comparable to both
+            # held chains, and a held worker's checkpoint could carry
+            # the very corruption under adjudication
+            grant["fresh"] = True
+            grant["checkpoint"] = None
+        return grant
 
     def _hedge_candidate(self, worker: str) -> dict | None:
         """Oldest single-leased in-flight unit not already held by this
@@ -299,7 +388,13 @@ class PoolCoordinator:
         worker = str(req.get("worker", "anon"))
         self.workers_seen.add(worker)
         self._expire_stale()
-        pending = [u for u in self.units.values() if u["state"] == U.PENDING]
+        if self.attest_mode != "off":
+            refused = self._verify_worker(worker, req)
+            if refused is not None:
+                return refused
+        pending = [u for u in self.units.values()
+                   if u["state"] == U.PENDING
+                   and worker not in u["suspects"]]
         if pending:
             u = min(pending, key=self._pick_key)
             self._pick_n += 1
@@ -307,6 +402,9 @@ class PoolCoordinator:
                 str(u["spec"].get("client", "anon"))
             ] = self._pick_n
             return self._grant(u, worker, hedge=False)
+        audit = self._audit_candidate(worker)
+        if audit is not None:
+            return self._grant_audit(audit, worker)
         if self.done:
             return {"ok": True, "done": True}
         if self.hedge_enabled:
@@ -315,6 +413,104 @@ class PoolCoordinator:
                 return self._grant(u, worker, hedge=True)
         return {"ok": True, "idle": True,
                 "retry_after_s": max(0.2, self.lease_ttl_s / 5.0)}
+
+    def _verify_worker(self, worker: str, req: dict) -> dict | None:
+        """Attested lease admission: quarantined workers and workers on
+        a different toolchain are refused BEFORE they compute anything.
+        Returns the refusal reply, or None to proceed."""
+        from ..attest import AttestationError, toolchain_matches
+
+        if worker in self.suspect_workers:
+            e = AttestationError(
+                f"worker {worker!r} is quarantined as SUSPECT (a "
+                "tiebreak refuted its attested result)",
+                site="coordinator.lease", unit="")
+            return {"ok": False, "refused": "suspect", **error_obj(e)}
+        tc = req.get("toolchain")
+        if tc is not None:
+            if self._toolchain is None:
+                from ..attest import toolchain_fingerprint
+
+                self._toolchain = toolchain_fingerprint()
+            field = toolchain_matches(self._toolchain, tc)
+            if field:
+                self.counters["toolchain_refused"] += 1
+                self._pool_event("toolchain_refused", worker=worker,
+                                 field=field)
+                e = AttestationError(
+                    f"worker {worker!r} toolchain mismatch on "
+                    f"{field!r}: coordinator "
+                    f"{self._toolchain.get(field)!r} vs worker "
+                    f"{tc.get(field)!r} — results would not be "
+                    "comparable (exec-cache key fields)",
+                    site="coordinator.lease", unit="")
+                return {"ok": False, "refused": "toolchain",
+                        **error_obj(e)}
+        return None
+
+    # ---- sampled re-execution audit (attest on, DESIGN.md §24) ----------
+
+    def _audit_due(self, u: dict) -> bool:
+        if (self.audit_rate <= 0 or self.attest_mode == "off"
+                or u["spec"].get("kind") == "ingest"):
+            return False
+        if self.audit_rate >= 1.0:
+            return True
+        import hashlib
+
+        blob = f"{u['spec']['key']}:{u['spec']['unit_id']}:audit"
+        frac = int(hashlib.sha256(blob.encode()).hexdigest()[:8], 16)
+        return frac / 0xFFFFFFFF < self.audit_rate
+
+    def _audit_candidate(self, worker: str) -> str | None:
+        """A pending audit this worker may serve: a DIFFERENT worker
+        than the original acker, preferably. When the campaign is
+        otherwise complete and nobody else will ever ask, a self-audit
+        beats hanging the campaign (it still catches nondeterministic
+        corruption, not a systematically-wrong worker)."""
+        if not self.audits:
+            return None
+        live = any(u["state"] in (U.PENDING, U.LEASED)
+                   for u in self.units.values())
+        fallback = None
+        for unit_id, a in self.audits.items():
+            u = self.units.get(unit_id)
+            if (a["state"] != "pending" or u is None
+                    or u["state"] != U.DONE or worker in a["tried"]):
+                continue
+            if worker != a["orig"]:
+                return unit_id
+            if not live:
+                fallback = fallback or unit_id
+        return fallback
+
+    def _grant_audit(self, unit_id: str, worker: str) -> dict:
+        u = self.units[unit_id]
+        a = self.audits[unit_id]
+        u["epoch"] += 1
+        a.update(state="leased", worker=worker, epoch=u["epoch"],
+                 deadline=self.clock() + self.lease_ttl_s)
+        a["tried"].add(worker)
+        self.counters["audits"] += 1
+        self.journal.append({
+            "t": "lease", "unit_id": unit_id, "worker": worker,
+            "epoch": u["epoch"], "key": u["spec"]["key"],
+            "hedge": False, "audit": True,
+        })
+        self._pool_event("audit", unit=unit_id, worker=worker,
+                         epoch=u["epoch"])
+        return {
+            "ok": True,
+            "unit": u["spec"],
+            "epoch": u["epoch"],
+            "lease_ttl_s": self.lease_ttl_s,
+            "checkpoint": None,
+            "pool_dir": self.pool_dir,
+            "hedge": False,
+            "audit": True,
+            "fresh": True,
+            "attest": self.attest_mode,
+        }
 
     def _pick_key(self, u: dict):
         """Lease pick order = the serve scheduler's QoS tiers carried
@@ -334,7 +530,12 @@ class PoolCoordinator:
         epoch = int(req.get("epoch", 0))
         self.counters["heartbeats"] += 1
         u = self.units.get(unit_id)
-        if u is None or u["state"] in (U.DONE, U.POISON):
+        a = self.audits.get(unit_id)
+        if (a is not None and a["state"] == "leased"
+                and a["worker"] == worker and a["epoch"] == epoch):
+            a["deadline"] = self.clock() + self.lease_ttl_s
+            return {"ok": True, "lease_ttl_s": self.lease_ttl_s}
+        if u is None or u["state"] in (U.DONE, U.POISON, U.SUSPECT):
             return {"ok": True, "lost": True}
         lease = u["leases"].get(worker)
         if lease is None and u["state"] == U.PENDING and epoch == u["epoch"]:
@@ -370,33 +571,47 @@ class PoolCoordinator:
                 f"{unit_id}: ack key mismatch (campaign changed under "
                 "the worker?)"
             )
-        if u["state"] == U.DONE:
-            # the losing half of a hedged pair, or a redelivery after a
-            # lost ack reply — discard, first ACK already won
-            self.counters["duplicates"] += 1
-            self._pool_event("duplicate", unit=unit_id, worker=worker,
-                             epoch=epoch)
-            return {"ok": True, "accepted": False, "duplicate": True}
+        if u["state"] in (U.DONE, U.SUSPECT):
+            # the losing half of a hedged pair, an audit re-execution, or
+            # a redelivery after a lost ack reply. First ACK already won
+            # the result — but the loser's chain is evidence, not waste:
+            # journal it and compare heads (DESIGN.md §24)
+            return self._h_ack_dup(u, req, worker, epoch)
+        if u["held"]:
+            # third execution after an attested divergence: adjudicate
+            return self._h_tiebreak(u, req, worker, epoch)
         # first-ACK-wins: accept even from an expired epoch — the unit is
         # deterministic, a slow-but-alive "lost" worker's result is the
         # same result
         result = req.get("result")
         resumed = int(req.get("resumed_steps", 0))
-        self.journal.append({
+        attest = req.get("attest") if self.attest_mode != "off" else None
+        rec = {
             "t": "ack", "unit_id": unit_id, "worker": worker,
             "epoch": epoch, "key": u["spec"]["key"], "result": result,
             "resumed_steps": resumed,
-        })
+        }
+        if attest:
+            rec["attest"] = attest
+        self.journal.append(rec)
         # result durable, worker not yet told: a crash here must replay
         # to DONE and fold the worker's re-ack away as a duplicate
         chaos.crashpoint("coordinator.post-ack")
         u["state"] = U.DONE
         u["result"] = result
         u["resumed_steps"] = resumed
+        u["attest"] = attest
+        u["ack_worker"] = worker
         u["leases"].clear()
         self.counters["acks"] += 1
         self._pool_event("ack", unit=unit_id, worker=worker, epoch=epoch,
                          resumed_steps=resumed)
+        if (not req.get("audit") and unit_id not in self.audits
+                and self._audit_due(u)):
+            self.audits[unit_id] = {
+                "state": "pending", "orig": worker, "worker": None,
+                "epoch": 0, "deadline": 0.0, "tried": set(),
+            }
         # unit checkpoint is dead weight once the result is durable
         rel = self._checkpoint_rel(unit_id)
         if rel:
@@ -405,6 +620,179 @@ class PoolCoordinator:
             except OSError:
                 pass
         return {"ok": True, "accepted": True}
+
+    def _h_ack_dup(self, u: dict, req: dict, worker: str,
+                   epoch: int) -> dict:
+        """A second execution's ack for an already-terminal unit. The
+        legacy path dropped these on the floor; with attestation the
+        loser's chain head is the cheapest integrity check we will ever
+        get — a full independent re-execution that already happened."""
+        unit_id = u["spec"]["unit_id"]
+        attest = req.get("attest") if self.attest_mode != "off" else None
+        is_audit = bool(req.get("audit"))
+        rec = {
+            "t": "ack_dup", "unit_id": unit_id, "worker": worker,
+            "epoch": epoch, "key": u["spec"]["key"],
+            "result": req.get("result"),
+            "resumed_steps": int(req.get("resumed_steps", 0)),
+        }
+        if attest:
+            rec["attest"] = attest
+        if is_audit:
+            rec["audit"] = True
+        self.journal.append(rec)
+        self.counters["duplicates"] += 1
+        a = self.audits.get(unit_id)
+        audit_closing = (is_audit and a is not None
+                         and a.get("worker") == worker)
+        if u["state"] == U.SUSPECT or u["attest"] is None or not attest:
+            # terminal-suspect unit, attest off, or a chainless twin:
+            # nothing to compare, the record alone is the retention win
+            if audit_closing:
+                a["state"] = "done"
+            self._pool_event("duplicate", unit=unit_id, worker=worker,
+                             epoch=epoch)
+            return {"ok": True, "accepted": False, "duplicate": True}
+        from ..attest import chain as _chain
+
+        if not _chain.comparable(u["attest"], attest):
+            # warm-forked / OOM-halved cadence: equally valid, not
+            # comparable — count it, never suspect it
+            self.counters["attest_incomparable"] += 1
+            if audit_closing:
+                a["state"] = "done"
+                self.journal.append({"t": "audit", "unit_id": unit_id,
+                                     "worker": worker, "ok": None})
+            self._pool_event("duplicate", unit=unit_id, worker=worker,
+                             epoch=epoch)
+            return {"ok": True, "accepted": False, "duplicate": True}
+        if _chain.heads_equal(u["attest"], attest):
+            self.counters["attest_confirms"] += 1
+            if audit_closing:
+                a["state"] = "done"
+                self.counters["audits_ok"] += 1
+                self.journal.append({"t": "audit", "unit_id": unit_id,
+                                     "worker": worker, "ok": True})
+                self._pool_event("audit_ok", unit=unit_id, worker=worker)
+            self._pool_event("attest_confirm", unit=unit_id,
+                             worker=worker, epoch=epoch)
+            return {"ok": True, "accepted": False, "duplicate": True}
+        return self._attest_mismatch(u, req, worker, epoch, attest)
+
+    def _attest_mismatch(self, u: dict, req: dict, worker: str,
+                         epoch: int, attest: dict) -> dict:
+        """Two comparable chains disagree: neither result can be
+        trusted (first-ack-wins picked a winner by latency, not by
+        correctness). Hold BOTH payloads, void the unit back to PENDING
+        for a third execution on a different worker, and bar both
+        claimants from picking it back up."""
+        unit_id = u["spec"]["unit_id"]
+        self.counters["attest_mismatches"] += 1
+        held = [
+            {"worker": u["ack_worker"], "result": u["result"],
+             "resumed_steps": u["resumed_steps"], "attest": u["attest"]},
+            {"worker": worker, "result": req.get("result"),
+             "resumed_steps": int(req.get("resumed_steps", 0)),
+             "attest": attest},
+        ]
+        workers = sorted({str(h["worker"]) for h in held})
+        self.journal.append({
+            "t": "suspect", "unit_id": unit_id, "key": u["spec"]["key"],
+            "workers": workers, "held": held,
+        })
+        chaos.crashpoint("coordinator.post-ack")
+        u["state"] = U.PENDING
+        u["result"] = None
+        u["resumed_steps"] = 0
+        u["attest"] = None
+        u["ack_worker"] = None
+        u["held"] = held
+        u["suspects"] |= set(workers)
+        u["leases"].clear()
+        self.audits.pop(unit_id, None)
+        # either claimant may have rewritten the unit checkpoint after
+        # the first ack — it is evidence-tainted, force fresh runs
+        rel = self._checkpoint_rel(unit_id)
+        if rel:
+            try:
+                os.unlink(os.path.join(self.pool_dir, rel))
+            except OSError:
+                pass
+        self._pool_event("suspect", unit=unit_id, workers=workers)
+        return {"ok": True, "accepted": False, "duplicate": True,
+                "mismatch": True}
+
+    def _h_tiebreak(self, u: dict, req: dict, worker: str,
+                    epoch: int) -> dict:
+        """Third execution's verdict on a held divergence: whichever
+        held chain it reproduces was right, the other worker is
+        quarantined as SUSPECT. No match -> the unit itself is SUSPECT
+        (terminal, unresolved) and all three chains are preserved."""
+        from ..attest import chain as _chain
+
+        unit_id = u["spec"]["unit_id"]
+        attest = req.get("attest") if self.attest_mode != "off" else None
+        third = {"worker": worker, "result": req.get("result"),
+                 "resumed_steps": int(req.get("resumed_steps", 0)),
+                 "attest": attest}
+        match = None
+        if attest:
+            for h in u["held"]:
+                if (_chain.comparable(h["attest"], attest)
+                        and _chain.heads_equal(h["attest"], attest)):
+                    match = h
+                    break
+        self.counters["verdicts"] += 1
+        if match is not None:
+            quarantined = sorted(
+                str(h["worker"]) for h in u["held"] if h is not match)
+            self.journal.append({
+                "t": "verdict", "unit_id": unit_id,
+                "key": u["spec"]["key"], "outcome": "resolved",
+                "worker": worker, "epoch": epoch,
+                "result": req.get("result"),
+                "resumed_steps": third["resumed_steps"],
+                "attest": attest, "quarantined": quarantined,
+                "confirmed": str(match["worker"]),
+            })
+            chaos.crashpoint("coordinator.post-ack")
+            u["state"] = U.DONE
+            u["result"] = req.get("result")
+            u["resumed_steps"] = third["resumed_steps"]
+            u["attest"] = attest
+            u["ack_worker"] = worker
+            u["held"] = []
+            u["leases"].clear()
+            self.counters["acks"] += 1
+            for w in quarantined:
+                if w not in self.suspect_workers:
+                    self.suspect_workers.add(w)
+                    self.counters["suspects"] += 1
+                    self._pool_event("suspect_quarantine", worker=w,
+                                     unit=unit_id)
+            rel = self._checkpoint_rel(unit_id)
+            if rel:
+                try:
+                    os.unlink(os.path.join(self.pool_dir, rel))
+                except OSError:
+                    pass
+            self._pool_event("verdict", unit=unit_id, worker=worker,
+                             outcome="resolved")
+            return {"ok": True, "accepted": True}
+        # three executions, three stories (or the tiebreak came back
+        # chainless): nobody can be trusted, keep all the evidence
+        held = u["held"] + [third]
+        self.journal.append({
+            "t": "verdict", "unit_id": unit_id, "key": u["spec"]["key"],
+            "outcome": "unresolved", "held": held,
+        })
+        chaos.crashpoint("coordinator.post-ack")
+        u["state"] = U.SUSPECT
+        u["held"] = held
+        u["leases"].clear()
+        self._pool_event("verdict", unit=unit_id, worker=worker,
+                         outcome="unresolved")
+        return {"ok": True, "accepted": False, "suspect": True}
 
     def _h_enqueue(self, req: dict) -> dict:
         """Dynamic-mode admission (the elastic front-end's dispatch
@@ -453,13 +841,14 @@ class PoolCoordinator:
                 continue
             if u["state"] == U.LEASED:
                 leased.append(u["spec"]["unit_id"])
-            elif u["state"] in (U.DONE, U.POISON):
+            elif u["state"] in (U.DONE, U.POISON, U.SUSPECT):
                 finished.append({
                     "unit_id": u["spec"]["unit_id"],
                     "state": u["state"],
                     "result": u["result"],
                     "resumed_steps": u["resumed_steps"],
                     "kills": sorted(u["kills"]),
+                    "suspects": sorted(u["suspects"]),
                 })
         return {"ok": True, "finished": finished, "leased": leased}
 
@@ -469,9 +858,12 @@ class PoolCoordinator:
     def done(self) -> bool:
         if self.dynamic:
             return False  # a service is never "done"; workers idle-wait
-        return all(
-            u["state"] in (U.DONE, U.POISON) for u in self.units.values()
-        )
+        if not all(u["state"] in (U.DONE, U.POISON, U.SUSPECT)
+                   for u in self.units.values()):
+            return False
+        # open audits hold the campaign: a sampled re-execution that
+        # never runs is a sampled re-execution that never detects
+        return all(a["state"] == "done" for a in self.audits.values())
 
     def results(self) -> list[dict]:
         """Per-unit outcomes in index order (poisoned units carry
@@ -486,11 +878,13 @@ class PoolCoordinator:
                 "result": u["result"],
                 "resumed_steps": u["resumed_steps"],
                 "kills": sorted(u["kills"]),
+                "suspects": sorted(u["suspects"]),
             })
         return out
 
     def _stats(self) -> dict:
-        states = {s: 0 for s in (U.PENDING, U.LEASED, U.DONE, U.POISON)}
+        states = {s: 0 for s in (U.PENDING, U.LEASED, U.DONE, U.POISON,
+                                 U.SUSPECT)}
         leases_active = 0
         for u in self.units.values():
             states[u["state"]] += 1
@@ -515,12 +909,17 @@ class PoolCoordinator:
             "units_total": len(self.units),
             "units_done": s["units"][U.DONE],
             "units_poisoned": s["units"][U.POISON],
+            "units_suspect": s["units"][U.SUSPECT],
             "workers_seen": len(s["workers_seen"]),
             "redispatches": s["counters"]["redispatches"],
             "expired_leases": s["counters"]["expired"],
             "hedges": s["counters"]["hedges"],
             "duplicate_acks": s["counters"]["duplicates"],
             "heartbeats": s["counters"]["heartbeats"],
+            "attest_confirms": s["counters"]["attest_confirms"],
+            "attest_mismatches": s["counters"]["attest_mismatches"],
+            "audits": s["counters"]["audits"],
+            "suspect_workers": s["counters"]["suspects"],
         }
 
     def _pool_event(self, kind: str, **args) -> None:

@@ -14,7 +14,11 @@ pool directory, holding pool record types:
 
     lease   {unit_id, worker, epoch, key, hedge}
     expire  {unit_id, worker, epoch}          (missed heartbeat)
-    ack     {unit_id, worker, epoch, key, result, resumed_steps}
+    ack     {unit_id, worker, epoch, key, result, resumed_steps, attest}
+    ack_dup {unit_id, worker, epoch, key, result, resumed_steps, attest}
+    suspect {unit_id, key, workers, held}      (attested twins diverged)
+    verdict {unit_id, key, outcome, ...}       (tiebreak resolution)
+    audit   {unit_id, worker, ok, attest}      (sampled re-execution)
     poison  {unit_id, key, kills}
     note    {msg}                              (operator annotations)
     drain   {}                                 (campaign completed)
@@ -22,8 +26,14 @@ pool directory, holding pool record types:
 `fold_unit_records` rebuilds the restart state with the same invariants
 as serve's `fold_records`: duplicate-tolerant and first-ACK-wins — the
 first `ack` for a unit is authoritative; later acks (the losing half of
-a hedged pair, or a redelivery) are discarded. Expire records survive
-the fold so poison counting spans coordinator restarts.
+a hedged pair, or a redelivery) are RETAINED as `ack_dup` records with
+their full payload (attestation needs both sides of a hedged pair) but
+never change the result. Expire records survive the fold so poison
+counting spans coordinator restarts. The attestation records
+(DESIGN.md §24) are order-sensitive: a `suspect` voids the unit's
+result back to PENDING with both held payloads on record, and a
+`verdict` either restores an authoritative result (quarantining the
+divergent worker) or parks the unit in the terminal SUSPECT state.
 """
 
 from __future__ import annotations
@@ -35,11 +45,16 @@ import json
 #: fleet-level analogue of build_fleet_isolated's element quarantine
 DEFAULT_POISON_THRESHOLD = 2
 
-# unit lifecycle states (coordinator-side)
+# unit lifecycle states (coordinator-side). SUSPECT is distinct from
+# POISON: poison marks a unit that repeatedly KILLS workers (the unit is
+# the problem), suspect marks a unit whose attested results DIVERGED and
+# could not be tiebroken (some worker is the problem, and we can no
+# longer tell which result to trust) — see DESIGN.md §24.
 PENDING = "PENDING"
 LEASED = "LEASED"
 DONE = "DONE"
 POISON = "POISON"
+SUSPECT = "SUSPECT"
 
 
 def unit_key(unit: dict) -> str:
@@ -173,7 +188,9 @@ def fold_unit_records(records: list[dict]):
             unit_id,
             {"result": None, "result_epoch": None, "kills": set(),
              "max_epoch": 0, "poison": False, "resumed_steps": 0,
-             "key": None},
+             "key": None, "attest": None, "ack_worker": None,
+             "dup_acks": [], "suspects": set(), "held": [],
+             "suspect": None, "audits": []},
         )
 
     for rec in records:
@@ -200,7 +217,61 @@ def fold_unit_records(records: list[dict]):
                 u["result_epoch"] = int(rec.get("epoch", 0))
                 u["resumed_steps"] = int(rec.get("resumed_steps", 0))
                 u["key"] = rec.get("key") or u["key"]
+                u["attest"] = rec.get("attest")
+                u["ack_worker"] = rec.get("worker")
             u["max_epoch"] = max(u["max_epoch"], int(rec.get("epoch", 0)))
+            clean_drain = False
+        elif t == "ack_dup":
+            # the losing half of a hedged pair (or an audit re-run),
+            # retained with its FULL payload so cross-checks and
+            # post-hoc audits can see both sides — never authoritative
+            u = _u(str(rec["unit_id"]))
+            u["dup_acks"].append({
+                "worker": str(rec.get("worker", "?")),
+                "epoch": int(rec.get("epoch", 0)),
+                "result": rec.get("result"),
+                "resumed_steps": int(rec.get("resumed_steps", 0)),
+                "attest": rec.get("attest"),
+                "audit": bool(rec.get("audit")),
+            })
+            u["max_epoch"] = max(u["max_epoch"], int(rec.get("epoch", 0)))
+            clean_drain = False
+        elif t == "suspect":
+            # attested twins diverged: the unit's result is VOIDED back
+            # to pending, both held payloads stay on record, and the
+            # divergent workers are barred from re-running this unit
+            u = _u(str(rec["unit_id"]))
+            u["result"] = None
+            u["result_epoch"] = None
+            u["resumed_steps"] = 0
+            u["attest"] = None
+            u["ack_worker"] = None
+            u["suspect"] = "pending"
+            u["suspects"] |= {str(w) for w in rec.get("workers", [])}
+            u["held"] = list(rec.get("held") or [])
+            clean_drain = False
+        elif t == "verdict":
+            u = _u(str(rec["unit_id"]))
+            if rec.get("outcome") == "resolved":
+                u["result"] = rec.get("result")
+                u["result_epoch"] = int(rec.get("epoch", 0))
+                u["resumed_steps"] = int(rec.get("resumed_steps", 0))
+                u["attest"] = rec.get("attest")
+                u["ack_worker"] = rec.get("worker")
+                u["suspect"] = None
+                u["suspects"] |= {
+                    str(w) for w in rec.get("quarantined", [])}
+                u["held"] = []
+            else:  # unresolved: three mutually-divergent results
+                u["suspect"] = "terminal"
+                u["held"] = list(rec.get("held") or u["held"])
+            clean_drain = False
+        elif t == "audit":
+            u = _u(str(rec["unit_id"]))
+            u["audits"].append({
+                "worker": str(rec.get("worker", "?")),
+                "ok": rec.get("ok"),
+            })
             clean_drain = False
         elif t == "poison":
             u = _u(str(rec["unit_id"]))
@@ -229,14 +300,26 @@ def pool_compactor(records: list[dict]) -> list[dict]:
 
     `max_epoch >= result_epoch` always holds in a real fold (the ack
     itself raises max_epoch), so re-folding the compacted list restores
-    both epochs exactly."""
+    both epochs exactly.
+
+    Attestation history (ack_dup / suspect / verdict / audit records,
+    DESIGN.md §24) is EVIDENCE, not just state — compaction re-emits a
+    unit's full ack/attestation flow verbatim, in original order,
+    whenever any such record exists, because the fold of that flow is
+    order-sensitive and post-hoc audits need both sides of every
+    divergence."""
     specs: dict[str, dict] = {}
+    flows: dict[str, list] = {}
+    _FLOW = ("ack", "ack_dup", "suspect", "verdict", "audit")
     for rec in records:
-        if rec.get("t") == "unit":
+        t = rec.get("t")
+        if t == "unit":
             spec = rec.get("unit") or {}
             uid = str(spec.get("unit_id", ""))
             if uid and uid not in specs:
                 specs[uid] = rec
+        elif t in _FLOW:
+            flows.setdefault(str(rec.get("unit_id", "")), []).append(rec)
     units, clean = fold_unit_records(records)
     out: list[dict] = []
     for unit_id, u in units.items():
@@ -249,12 +332,20 @@ def pool_compactor(records: list[dict]) -> list[dict]:
         for worker in sorted(u["kills"]):
             out.append({"t": "expire", "unit_id": unit_id,
                         "worker": worker, "epoch": 0})
-        if u["result"] is not None:
+        flow = flows.get(unit_id, [])
+        if any(r.get("t") != "ack" for r in flow):
+            out.extend(flow)
+            if u["poison"] and u["result"] is None:
+                out.append({"t": "poison", "unit_id": unit_id,
+                            "key": u["key"], "kills": sorted(u["kills"])})
+        elif u["result"] is not None:
             out.append({"t": "ack", "unit_id": unit_id,
-                        "worker": "compact",
+                        "worker": u["ack_worker"] or "compact",
                         "epoch": u["result_epoch"], "key": u["key"],
                         "result": u["result"],
-                        "resumed_steps": u["resumed_steps"]})
+                        "resumed_steps": u["resumed_steps"],
+                        **({"attest": u["attest"]} if u["attest"]
+                           else {})})
         elif u["poison"]:
             out.append({"t": "poison", "unit_id": unit_id,
                         "key": u["key"], "kills": sorted(u["kills"])})

@@ -162,11 +162,23 @@ class PoolWorker:
         self.units_done = 0
         self.units_lost = 0
         self._chunks_seen = 0
+        self._toolchain_cache = None
         # warm compiled fleets, one per geometry bucket: keyed by
         # (config JSON, events capacity, chunk_steps), so serve jobs in
         # the same bucket reuse the compiled program across units — the
         # per-worker half of the front-end's slot-bucket design
         self._bucket_fleets: dict[tuple, object] = {}
+
+    def _toolchain(self) -> dict:
+        """The jax/jaxlib/backend triple the coordinator verifies on
+        attested lease grants (chain heads from different toolchains
+        would diverge for boring reasons). Sent on every lease; ignored
+        by attest-off coordinators."""
+        if self._toolchain_cache is None:
+            from ..attest import toolchain_fingerprint
+
+            self._toolchain_cache = toolchain_fingerprint()
+        return self._toolchain_cache
 
     # ---- coordinator RPC with reconnect ----------------------------------
 
@@ -195,8 +207,21 @@ class PoolWorker:
         idle_since = None
         while True:
             try:
-                reply = self._call({"verb": "lease"})
+                reply = self._call({"verb": "lease",
+                                    "toolchain": self._toolchain()})
             except (ConnectionError, OSError):
+                return EX_TEMPFAIL
+            if reply.get("refused"):
+                # attested admission said no — quarantined as SUSPECT or
+                # wrong toolchain. Terminal for this worker: retrying
+                # with the same identity/toolchain can never succeed.
+                import json
+                import sys
+
+                print(json.dumps({"worker": self.worker_id,
+                                  "refused": reply["refused"],
+                                  "error": reply.get("error")}),
+                      file=sys.stderr, flush=True)
                 return EX_TEMPFAIL
             if not reply.get("ok", False):
                 time.sleep(jittered(1.0, rng=self.rng))
@@ -240,15 +265,21 @@ class PoolWorker:
         # dying here is the classic lost-result window the coordinator's
         # lease expiry + re-dispatch must absorb
         chaos.crashpoint("worker.pre-ack")
+        ack = {
+            "verb": "ack",
+            "unit_id": unit["unit_id"],
+            "epoch": epoch,
+            "key": unit["key"],
+            "result": result,
+            "resumed_steps": resumed_steps,
+        }
+        attest = (result or {}).get("detail", {}).get("attest")
+        if attest:
+            ack["attest"] = attest
+        if grant.get("audit"):
+            ack["audit"] = True
         try:
-            self._call({
-                "verb": "ack",
-                "unit_id": unit["unit_id"],
-                "epoch": epoch,
-                "key": unit["key"],
-                "result": result,
-                "resumed_steps": resumed_steps,
-            })
+            self._call(ack)
             self.units_done += 1
         except (ConnectionError, OSError):
             # result lost with the coordinator; the unit's checkpoint
@@ -352,25 +383,55 @@ class PoolWorker:
         # cache hit means compile never eats lease TTL
         fleet.warm_exec()
 
+        attest_on = grant.get("attest") == "chain"
+        # tiebreak / audit re-runs are granted `fresh`: no checkpoint
+        # resume, no warm fork, no checkpoint WRITES — their chains must
+        # cover the whole run, and the unit checkpoint on disk belongs
+        # to the execution under adjudication
+        fresh = bool(grant.get("fresh"))
+        fleet.attest = None  # bucketed fleets are reused across units
         resumed_steps = 0
-        if grant.get("checkpoint"):
+        ckpt_attest = None
+        if grant.get("checkpoint") and not fresh:
             try:
                 snap = load_element_checkpoint(
                     ckpt_path, fleet.elem_cfgs[0], trace
                 )
                 fleet.restore_element(0, snap)
                 resumed_steps = int(fleet.steps_run[0])
-            except Exception:  # corrupt/mismatched: fresh start
+                ckpt_attest = snap.get("attest")
+            except Exception:
+                # corrupt / mismatched / AttestationError (payload sha
+                # refuted the checkpoint, §24): fresh start — slower but
+                # honest, and the fresh chain covers every chunk we ack
                 resumed_steps = 0
-        if resumed_steps == 0 and unit.get("warm_cache") and self.warm_cache:
+                ckpt_attest = None
+        if (resumed_steps == 0 and not fresh
+                and unit.get("warm_cache") and self.warm_cache):
             resumed_steps = self._warm_fork(fleet, trace)
+        if attest_on:
+            from ..attest import FleetAttest
+
+            fa = FleetAttest()
+            cs = int(unit["chunk_steps"])
+            if (ckpt_attest and ckpt_attest.get("head")
+                    and int(ckpt_attest.get("chunk_steps", 0)) == cs):
+                fa.track(0, cs, start=int(ckpt_attest.get("start", 0)),
+                         head=ckpt_attest["head"],
+                         chunks=int(ckpt_attest.get("chunks", 0)))
+            else:
+                # fresh run, warm fork, or pre-attestation checkpoint:
+                # the chain's coverage starts where this execution does
+                fa.track(0, cs, start=resumed_steps)
+            fleet.attest = fa
 
         def on_chunk(sup):
             self._chunks_seen += 1
             # checkpoint BEFORE the crashpoint: a worker killed at chunk
             # N leaves chunk N durable, so the re-lease resumes exactly
             # where the victim died
-            self._checkpoint(ckpt_path, fleet, unit_id)
+            if not fresh:
+                self._checkpoint(ckpt_path, fleet, unit_id)
             chaos.crashpoint("worker.post-checkpoint")
             hb.steps = int(fleet.steps_run[0])
             if hb.lost:
@@ -384,6 +445,7 @@ class PoolWorker:
         try:
             sup.run(max_steps=int(unit["max_steps"]))
         except BaseException:
+            fleet.attest = None
             if bucketed:
                 # evict the failed workload so the warm fleet is clean
                 # for the next unit in this bucket
@@ -433,6 +495,11 @@ class PoolWorker:
             result["detail"]["counters"] = {
                 k: [int(x) for x in v] for k, v in ec.items()
             }
+        if attest_on and fleet.attest is not None:
+            # present ONLY under --attest chain, so attest-off records
+            # stay byte-identical (same rule as `devices` above)
+            result["detail"]["attest"] = fleet.attest.payload(0)
+            fleet.attest = None
         if bucketed:
             fleet.clear_element(0)
         return result, resumed_steps

@@ -73,10 +73,14 @@ class DispatchScheduler:
         spawn: bool = True,
         poll_every_s: float = 0.2,
         devices: int = 0,
+        attest: str = "off",
+        audit_rate: float = 0.0,
     ):
         self.cfg = cfg
         self.journal = journal
         self.obs = obs
+        self.attest = str(attest or "off")
+        self.audit_rate = float(audit_rate or 0.0)
         self.state_dir = str(state_dir)
         self.pool_dir = str(pool_dir)
         os.makedirs(self.pool_dir, exist_ok=True)
@@ -285,15 +289,16 @@ class DispatchScheduler:
             return False  # spawn in flight or backing off
         self._coord_spawn_t = now
         self.coordinator_adopted = False
-        self._coord_proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "primesim_tpu.cli", "coordinator",
-                "--pool-dir", self.pool_dir,
-                "--socket", self.pool_socket,
-                "--lease-ttl", str(self.lease_ttl_s),
-            ],
-            stdout=subprocess.DEVNULL,
-        )
+        argv = [
+            sys.executable, "-m", "primesim_tpu.cli", "coordinator",
+            "--pool-dir", self.pool_dir,
+            "--socket", self.pool_socket,
+            "--lease-ttl", str(self.lease_ttl_s),
+        ]
+        if self.attest != "off":
+            argv += ["--attest", self.attest,
+                     "--audit-rate", str(self.audit_rate)]
+        self._coord_proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL)
         self._serve_event("spawn_coordinator", pool=self.pool_dir,
                           pid=self._coord_proc.pid)
         return False  # let it bind; enqueue on a later tick
@@ -310,7 +315,7 @@ class DispatchScheduler:
             self.queue.remove(job_id)
             self.dispatched.add(job_id)
             moved = True
-            if reply.get("state") in ("DONE", "POISON"):
+            if reply.get("state") in ("DONE", "POISON", "SUSPECT"):
                 # finished while we were down (front-end restart path)
                 self._finish_remote(job, reply)
         return moved
@@ -390,6 +395,28 @@ class DispatchScheduler:
             self.last_dispatch_t = time.time()
         rec = fin.get("result") or {}
         detail = rec.get("detail") or {}
+        if fin.get("state") == "SUSPECT":
+            # attested results diverged and the tiebreak could not
+            # adjudicate — terminal like poison, but the held evidence
+            # stays in the pool ledger for `primetpu audit` / fsck
+            suspects = fin.get("suspects") or []
+            self._serve_event("suspect", job_id=job.job_id,
+                              workers=suspects)
+            self._terminal(
+                job, J.QUARANTINED,
+                detail={
+                    "type": "AttestationError",
+                    "location": {"unit": job.job_id},
+                    "detail": (
+                        "attested results diverged across "
+                        f"{len(suspects)} worker(s) and a tiebreak did "
+                        "not adjudicate; held payloads are in the pool "
+                        "ledger"
+                    ),
+                    "workers": suspects,
+                },
+            )
+            return
         if fin.get("state") == "POISON":
             self._terminal(
                 job, J.QUARANTINED,
@@ -418,6 +445,10 @@ class DispatchScheduler:
             "instructions": int(detail.get("instructions", 0)),
             "counters": detail.get("counters"),
         }
+        if detail.get("attest"):
+            # chain head rides the journaled result, same as the local
+            # Scheduler's _element_result (fsck / offline audit hook)
+            result["attest"] = detail["attest"]
         self.total_instructions += result["instructions"]
         self.completed += 1
         self._terminal(job, J.DONE, result=result, detail={

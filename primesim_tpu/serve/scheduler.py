@@ -156,13 +156,14 @@ class SlotBucket:
     and contributes nothing to the vmapped step."""
 
     def __init__(self, cfg, n_slots: int, n_pages: int,
-                 chunk_steps: int = 128, obs=None):
+                 chunk_steps: int = 128, obs=None, attest: bool = False):
         self.cfg = cfg
         self.n_slots = int(n_slots)
         self.n_pages = int(n_pages)
         self.capacity = int(n_pages) * PAGE_EVENTS
         self.chunk_steps = int(chunk_steps)
         self.obs = obs
+        self.attest_on = bool(attest)
         self.fleet = self._make_fleet()
         self.slots: list[J.Job | None] = [None] * self.n_slots
 
@@ -171,6 +172,13 @@ class SlotBucket:
             self.cfg, self.n_slots, self.capacity,
             chunk_steps=self.chunk_steps,
         )
+        if self.attest_on:
+            # per-slot fingerprint chains (DESIGN.md §24): slots are
+            # tracked at splice and dropped at retire, so a job's chain
+            # covers exactly its own chunks
+            from ..attest import FleetAttest
+
+            fleet.attest = FleetAttest()
         # AOT warm (§23): with `serve --exec-cache on` the bucket's
         # chunk executable deserializes from disk instead of compiling
         # on the first dispatch tick. No-op when the cache is inactive.
@@ -227,10 +235,12 @@ class Scheduler:
         max_retries: int = 2,
         obs=None,
         warm_cache: bool = False,
+        attest: str = "off",
     ):
         self.cfg = cfg
         self.journal = journal
         self.obs = obs
+        self.attest = str(attest or "off")
         # warm-state cache consult at admission (DESIGN.md §16): a
         # resubmitted (trace, config) job starts from the deepest cached
         # snapshot whose content key matches, instead of step 0
@@ -244,7 +254,8 @@ class Scheduler:
         self.jobs_dir = os.path.join(self.state_dir, "jobs")
         os.makedirs(self.jobs_dir, exist_ok=True)
         self.buckets = [
-            SlotBucket(cfg, n, p, chunk_steps=chunk_steps, obs=obs)
+            SlotBucket(cfg, n, p, chunk_steps=chunk_steps, obs=obs,
+                       attest=self.attest == "chain")
             for n, p in sorted(buckets, key=lambda b: b[1])
         ]
         self.max_queue = int(max_queue)
@@ -661,12 +672,14 @@ class Scheduler:
         )
         resumed = False
         warm_steps = 0
+        ckpt_attest = None
         if job._resume_from:
             try:
                 snap = load_element_checkpoint(
                     job._resume_from, job._elem_cfg, job._trace
                 )
                 b.fleet.restore_element(i, snap)
+                ckpt_attest = snap.get("attest")
                 resumed = True
             except Exception as e:  # corrupt/mismatched ckpt: fresh start
                 self.journal.note(
@@ -716,6 +729,23 @@ class Scheduler:
                         "warm-hit", job_id=job.job_id, key=key, steps=steps
                     )
                 break
+        if b.fleet.attest is not None:
+            # continue a checkpointed chain when the cadence still
+            # matches; otherwise the chain restarts at the boundary the
+            # slot resumes from (migration, warm fork, fresh start) and
+            # `comparable()` keeps it from false-matching a full run
+            cs = b.chunk_steps
+            if ckpt_attest and ckpt_attest.get("head") \
+                    and int(ckpt_attest.get("chunk_steps", 0)) == cs:
+                b.fleet.attest.track(
+                    i, cs, start=int(ckpt_attest.get("start", 0)),
+                    head=ckpt_attest["head"],
+                    chunks=int(ckpt_attest.get("chunks", 0)),
+                )
+            else:
+                b.fleet.attest.track(
+                    i, cs, start=int(b.fleet.steps_run[i])
+                )
         b.slots[i] = job
         job.attempts += 1
         job.transition(J.RUNNING)
@@ -804,7 +834,7 @@ class Scheduler:
         bit-exactness contract the tests pin."""
         cyc = b.fleet.cycles[i]
         counters = b.fleet.element_counters(i)
-        return {
+        res = {
             "cycles": int(cyc.max()),
             "core_cycles": [int(c) for c in cyc],
             "steps": int(b.fleet.steps_run[i]),
@@ -813,6 +843,14 @@ class Scheduler:
                 k: [int(x) for x in v] for k, v in counters.items()
             },
         }
+        if b.fleet.attest is not None:
+            # the chain head rides the journaled result record, so fsck
+            # can cross-check it against the job's last element
+            # checkpoint and `primetpu audit` can re-derive it offline
+            at = b.fleet.attest.payload(i)
+            if at is not None:
+                res["attest"] = at
+        return res
 
     # ---- failure / retry -------------------------------------------------
 

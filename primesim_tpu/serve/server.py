@@ -83,6 +83,8 @@ class PrimeServer:
         quorum_policy: str = "block",
         node: str | None = None,
         devices: int = 0,
+        attest: str = "off",
+        audit_rate: float = 0.0,
     ):
         self.state_dir = str(state_dir)
         os.makedirs(self.state_dir, exist_ok=True)
@@ -128,6 +130,8 @@ class PrimeServer:
                 obs=obs,
                 spawn=spawn_pool,
                 devices=devices,
+                attest=attest,
+                audit_rate=audit_rate,
             )
         else:
             if devices:
@@ -149,6 +153,7 @@ class PrimeServer:
                 checkpoint_every_s=checkpoint_every_s,
                 obs=obs,
                 warm_cache=warm_cache,
+                attest=attest,
             )
         self.inbox: "queue.Queue[_Request]" = queue.Queue()
         self._draining = False

@@ -221,6 +221,47 @@ def trace_fingerprint(trace) -> str:
     return h.hexdigest()
 
 
+def _payload_digest(arrays: dict, cycle_base, steps_run) -> str:
+    """Self-digest over an element checkpoint's payload arrays, computed
+    from the in-memory values BEFORE the bytes head to disk. The CRC
+    manifest proves the file holds what was written; this proves what
+    was written is what the engine held — the two together bracket the
+    silent_corruption `checkpoint.payload` site (DESIGN.md §24)."""
+    h = hashlib.sha256(b"ptckpt-attest1")
+    h.update(np.int64(steps_run).tobytes())
+    h.update(np.int64(cycle_base).tobytes())
+    for k in sorted(arrays):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(arrays[k]).tobytes())
+    return h.hexdigest()
+
+
+def _attest_members(payload: dict | None) -> dict:
+    """Optional attestation-chain members (DESIGN.md §24). Only emitted
+    when the engine carries a chain, so --attest off checkpoints stay
+    byte-identical to pre-attestation files."""
+    if payload is None:
+        return {}
+    return {
+        "attest_head": np.frombuffer(
+            str(payload["head"]).encode(), dtype=np.uint8),
+        "attest_chunks": np.int64(payload["chunks"]),
+        "attest_start": np.int64(payload["start"]),
+        "attest_chunk_steps": np.int64(payload["chunk_steps"]),
+    }
+
+
+def _attest_from(z) -> dict | None:
+    if "attest_chunks" not in z:
+        return None
+    return {
+        "head": _str_field(z, "attest_head"),
+        "chunks": int(z["attest_chunks"]),
+        "start": int(z["attest_start"]),
+        "chunk_steps": int(z["attest_chunk_steps"]),
+    }
+
+
 def save_checkpoint(path: str, engine) -> None:
     """Snapshot an Engine mid-run (drains device counters first)."""
     engine._drain()
@@ -243,6 +284,10 @@ def save_checkpoint(path: str, engine) -> None:
         ),
         trace_sha=np.frombuffer(
             trace_fingerprint(engine.trace).encode(), dtype=np.uint8
+        ),
+        **_attest_members(
+            engine.attest.payload()
+            if getattr(engine, "attest", None) is not None else None
         ),
         **arrays,
     )
@@ -269,6 +314,10 @@ def save_stream_checkpoint(path: str, eng) -> None:
         config_json=np.frombuffer(eng.cfg.to_json().encode(), dtype=np.uint8),
         trace_sha=np.frombuffer(
             trace_fingerprint(eng.trace).encode(), dtype=np.uint8
+        ),
+        **_attest_members(
+            eng.attest.payload()
+            if getattr(eng, "attest", None) is not None else None
         ),
         **arrays,
     )
@@ -306,6 +355,8 @@ def load_stream_checkpoint(path: str, eng) -> None:
     eng.host_counters = {
         k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
     }
+    if getattr(eng, "attest", None) is not None:
+        eng.attest.seed(_attest_from(z), int(z["steps_run"]))
 
 
 def load_checkpoint(path: str, engine) -> None:
@@ -357,6 +408,8 @@ def load_checkpoint(path: str, engine) -> None:
     engine.host_counters = {
         k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
     }
+    if getattr(engine, "attest", None) is not None:
+        engine.attest.seed(_attest_from(z), int(z["steps_run"]))
 
 
 def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "",
@@ -377,6 +430,22 @@ def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "",
     arrays["host_counters"] = np.stack(
         [fleet.host_counters[k][i] for k in COUNTER_NAMES]
     )  # [n_counters, C]
+    at = (fleet.attest.payload(i)
+          if getattr(fleet, "attest", None) is not None else None)
+    extra = _attest_members(at)
+    if at is not None:
+        # the self-digest is taken from the in-memory values FIRST;
+        # anything that mangles the payload after this point (the
+        # silent_corruption site below, a DMA/disk fault in real life)
+        # fails verification at load even though the CRC manifest —
+        # computed over the already-corrupt bytes — passes
+        extra["attest_payload_sha"] = np.frombuffer(
+            _payload_digest(arrays, fleet.cycle_base[i],
+                            fleet.steps_run[i]).encode(),
+            dtype=np.uint8,
+        )
+    chaos.corrupt("checkpoint.payload",
+                  {"host_counters": arrays["host_counters"]})
     pre = getattr(fleet, "prefix_steps", None)
     keys = getattr(fleet, "prefix_cache_keys", None)
     atomic_save_npz(
@@ -400,6 +469,7 @@ def save_element_checkpoint(path: str, fleet, i: int, job_id: str = "",
             ).encode(),
             dtype=np.uint8,
         ),
+        **extra,
         **arrays,
     )
 
@@ -423,6 +493,21 @@ def load_element_checkpoint(path: str, cfg, trace) -> dict:
             f"rows but this build defines {len(COUNTER_NAMES)} — saved by an "
             "incompatible version"
         )
+    if "attest_payload_sha" in z:
+        from ..attest.errors import AttestationError
+
+        arrays = {k: v for k, v in z.items() if k.startswith("state_")}
+        arrays["host_counters"] = z["host_counters"]
+        got = _payload_digest(arrays, z["cycle_base"], z["steps_run"])
+        if got != _str_field(z, "attest_payload_sha"):
+            raise AttestationError(
+                f"{path}: checkpoint payload does not match its attest "
+                "self-digest — the file verifies its CRC manifest but "
+                "holds values the engine never committed (silent "
+                "corruption between hash and write)",
+                site="checkpoint.payload",
+                unit=_str_field(z, "job_id"),
+            )
     hc = z["host_counters"]
     return {
         "state": _state_from(z),
@@ -434,6 +519,7 @@ def load_element_checkpoint(path: str, cfg, trace) -> dict:
         "host_counters": {
             k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
         },
+        "attest": _attest_from(z),
     }
 
 
@@ -471,6 +557,13 @@ def save_fleet_checkpoint(path: str, fleet) -> None:
         trace_shas=np.frombuffer(
             ",".join(trace_fingerprint(t) for t in fleet.traces).encode(),
             dtype=np.uint8,
+        ),
+        **(
+            {"attest_json": np.frombuffer(
+                json.dumps([
+                    fleet.attest.payload(i) for i in range(B)
+                ], sort_keys=True).encode(), dtype=np.uint8)}
+            if getattr(fleet, "attest", None) is not None else {}
         ),
         **arrays,
     )
@@ -523,6 +616,12 @@ def load_fleet_checkpoint(path: str, fleet) -> None:
     fleet.host_counters = {
         k: hc[i].astype(np.int64) for i, k in enumerate(COUNTER_NAMES)
     }
+    if getattr(fleet, "attest", None) is not None and "attest_json" in z:
+        from ..attest import AttestChain
+
+        for i, p in enumerate(json.loads(bytes(z["attest_json"]).decode())):
+            if p and fleet.attest.chain(i) is not None:
+                fleet.attest.chains[i] = AttestChain.from_payload(p)
 
 
 # ---------------------------------------------------------------------------

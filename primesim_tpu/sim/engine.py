@@ -2012,6 +2012,11 @@ class Engine:
         # consults it at all (DESIGN.md §15 overhead contract)
         self.obs = None
         self.obs_label = "engine"
+        # attestation chain (attest.SoloAttest) — None means the chunked
+        # loop never fingerprints; like obs, the fused run() never
+        # consults it (DESIGN.md §24: --attest off is bit-exact by
+        # construction)
+        self.attest = None
         # prefix-fork provenance (checkpoint format v6): nonzero when this
         # engine's state was seeded from a shared-prefix / warm-cache
         # snapshot rather than run from step 0
@@ -2176,6 +2181,8 @@ class Engine:
                     self.obs_label, self.chunk_steps, t3 - t0,
                     self.host_counters, phases=phases,
                 )
+            if self.attest is not None:
+                self.attest.observe(self)
             if debug_invariants:
                 self.verify_invariants()
 

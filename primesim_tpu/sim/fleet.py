@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import sites as chaos
 from ..config.machine import MachineConfig
 from ..stats.counters import COUNTER_NAMES
 from ..trace.format import EV_BARRIER, EV_END, EV_LOCK, EV_UNLOCK, Trace
@@ -294,6 +295,10 @@ class FleetEngine:
         # branch in the chunked loops; fleet_run_loop never consults it
         self.obs = None
         self.obs_label = "fleet"
+        # attestation chains (attest.FleetAttest) — None means chunks are
+        # never fingerprinted (DESIGN.md §24); per-element chains advance
+        # only for elements live at chunk start, matching the solo loop
+        self.attest = None
         # prefix-fork provenance (checkpoint format v6): steps of shared
         # prefix each element was forked from, and the warm-cache key the
         # prefix was saved/loaded under (None = element ran from step 0)
@@ -459,7 +464,10 @@ class FleetEngine:
             self._dispatch_chunk()
             self.steps_run += np.where(live, self.chunk_steps, 0)
             self._drain()
+            self._corrupt_hook()
             self._rebase()
+            if self.attest is not None:
+                self.attest.observe(self, live)
             if self.overlap and not self.done():
                 self._prefetch_chunk()
             return
@@ -471,10 +479,13 @@ class FleetEngine:
         t1 = time.perf_counter()
         self.steps_run += np.where(live, self.chunk_steps, 0)
         self._drain()
+        self._corrupt_hook()
         t2 = time.perf_counter()
         self._rebase()
         t3 = time.perf_counter()
         phases = {"dispatch": t1 - t0, "drain": t2 - t1, "rebase": t3 - t2}
+        if self.attest is not None:
+            self.attest.observe(self, live)
         if self.overlap and not self.done():
             self._prefetch_chunk()
             phases["prefetch"] = time.perf_counter() - t3
@@ -482,6 +493,14 @@ class FleetEngine:
             self.obs_label, self.chunk_steps, t3 - t0, self.host_counters,
             phases=phases,
         )
+
+    def _corrupt_hook(self) -> None:
+        """silent_corruption site `fleet.counters` (DESIGN.md §24): a
+        flip lands AFTER drain and BEFORE the chunk is fingerprinted,
+        so the chain honestly covers the corrupted data — exactly what
+        a flaky DIMM does. Detection is attestation's cross-execution
+        compare, never this process."""
+        chaos.corrupt("fleet.counters", self.host_counters)
 
     def _dispatch_chunk(self) -> None:
         """Advance self.state by one chunk, consuming the prefetched
@@ -669,6 +688,10 @@ class FleetEngine:
         self.prefix_cache_keys[i] = None
         for k in self.host_counters:
             self.host_counters[k][i] = 0
+        # a new occupant never inherits the previous job's chain; the
+        # owner re-tracks the slot if the new workload is attested
+        if self.attest is not None:
+            self.attest.drop(i)
         if self.mesh is not None:
             self._reshard()
         if upload:

@@ -397,6 +397,10 @@ class RunSupervisor:
         }
         if self.kind == "stream":
             snap["cursor"] = eng.cursor.copy()
+        if getattr(eng, "attest", None) is not None:
+            # the chain must roll back with the state it covers, or a
+            # retried chunk would be linked twice
+            snap["attest"] = eng.attest.snapshot()
         return snap
 
     def _host_restore(self, snap: dict) -> None:
@@ -407,6 +411,8 @@ class RunSupervisor:
         eng.host_counters = snap["host_counters"]
         if self.kind == "stream":
             eng.cursor = snap["cursor"]
+        if "attest" in snap and getattr(eng, "attest", None) is not None:
+            eng.attest.restore(snap["attest"])
         # any overlapped speculation was made from a state we just rolled
         # away from; the identity check would reject it, this frees it
         getattr(eng, "discard_prefetch", lambda: None)()
@@ -489,6 +495,12 @@ class RunSupervisor:
                     # halving only changes the drain/rebase cadence, so
                     # results stay bit-exact; recompile is the cost
                     self.engine.chunk_steps = max(1, chunk // 2)
+                    at = getattr(self.engine, "attest", None)
+                    if at is not None:
+                        # the fingerprint chain is cadence-scoped (§24):
+                        # record the halving so this run's chain reads as
+                        # incomparable, never as a false divergence
+                        at.note_cadence(self.engine.chunk_steps)
                     self._log(
                         "degrade",
                         f"device OOM: chunk_steps {chunk} -> "

@@ -1,0 +1,726 @@
+"""Result integrity (DESIGN.md §24): the per-chunk fingerprint chain,
+ACK attestation at the pool coordinator (hedged-twin comparison,
+mismatch -> tiebreak -> SUSPECT, toolchain admission), the sampled
+re-execution audit, the offline `primetpu audit` replay, fsck's
+attestation-record checks, and the silent-corruption chaos trial's
+invariant F.
+
+Determinism discipline: chain heads are sha256 over committed host
+state, so every cross-engine assertion here is exact string equality —
+any flake IS the bug this subsystem exists to catch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from primesim_tpu.attest import (
+    AttestationError,
+    FleetAttest,
+    SoloAttest,
+    toolchain_fingerprint,
+)
+from primesim_tpu.attest.chain import comparable, heads_equal
+from primesim_tpu.config.machine import small_test_config
+from primesim_tpu.pool import DONE, PENDING, SUSPECT, PoolCoordinator
+from primesim_tpu.pool.units import build_units
+from primesim_tpu.serve.scheduler import parse_synth_spec
+from primesim_tpu.sim.engine import Engine
+from primesim_tpu.sim.fleet import FleetEngine
+from primesim_tpu.sim.supervisor import RunSupervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SYNTH = "fft_like:n_phases=1,points_per_core=8,ins_per_mem=4,seed={}"
+
+
+def _cfg():
+    return small_test_config(4)
+
+
+def _trace(seed=7):
+    cfg = _cfg()
+    return cfg, parse_synth_spec(SYNTH.format(seed), cfg.n_cores, True)
+
+
+def _at(head="a" * 64, chunks=3, start=0, chunk_steps=16):
+    return {"head": head, "chunks": chunks, "start": start,
+            "chunk_steps": chunk_steps}
+
+
+# ---- the chain itself ----------------------------------------------------
+
+
+def test_chain_determinism_solo_vs_fleet():
+    """The same workload at the same cadence commits the same chain,
+    whether it runs on the solo engine or as a fleet element — the
+    cross-engine property every downstream comparison stands on."""
+    cfg, trace = _trace()
+    solo = Engine(cfg, trace, chunk_steps=16)
+    solo.attest = SoloAttest(16)
+    solo.run_chunked()  # the chunk-committing path is what observes
+    fleet = FleetEngine(cfg, [trace], [{}], chunk_steps=16)
+    fleet.attest = FleetAttest()
+    fleet.attest.track(0, 16, start=0)
+    RunSupervisor(fleet, handle_signals=False).run(max_steps=100_000)
+    sp, fp = solo.attest.payload(), fleet.attest.payload(0)
+    assert sp["head"] and sp["chunks"] > 1
+    assert sp == fp
+
+
+@pytest.mark.slow  # slow: 8-device GSPMD compile; integrity-chaos CI job runs it
+def test_chain_determinism_sharded():
+    """An 8-virtual-device sharded fleet commits the same chain as the
+    single-device fleet: digests are taken from gathered host state,
+    never from per-shard views."""
+    from primesim_tpu.parallel.sharding import tile_mesh
+
+    cfg = small_test_config(n_cores=16, n_banks=8)
+    trace = parse_synth_spec(SYNTH.format(5), cfg.n_cores, True)
+
+    def run(mesh):
+        fleet = FleetEngine(cfg, [trace], [{}], chunk_steps=16,
+                            mesh=mesh)
+        fleet.attest = FleetAttest()
+        fleet.attest.track(0, 16, start=0)
+        RunSupervisor(fleet, handle_signals=False).run(max_steps=100_000)
+        return fleet.attest.payload(0)
+
+    assert run(None) == run(tile_mesh(8))
+
+
+def test_chunk_digest_sees_every_committed_field():
+    """One flipped counter — or one flipped state leaf — changes the
+    digest, and therefore every chain head after it: the sensitivity
+    the whole subsystem stands on."""
+    from primesim_tpu.attest.chain import _host_leaves, chunk_digest, link
+    from primesim_tpu.stats.counters import COUNTER_NAMES
+
+    cfg, trace = _trace()
+    fleet = FleetEngine(cfg, [trace], [{}], chunk_steps=16)
+    RunSupervisor(fleet, handle_signals=False).run(max_steps=100_000)
+    leaves = [leaf[0] for leaf in _host_leaves(fleet.state)]
+    counters = {k: fleet.host_counters[k][0] for k in COUNTER_NAMES}
+    args = (int(fleet.steps_run[0]), int(fleet.cycle_base[0]))
+    base = chunk_digest(*args, counters, leaves)
+    assert base == chunk_digest(*args, dict(counters), list(leaves))
+    flip = dict(counters,
+                instructions=np.asarray(counters["instructions"]) + 1)
+    assert chunk_digest(*args, flip, leaves) != base
+    bent = [np.asarray(l).copy() for l in leaves]
+    bent[0] = np.where(np.ones_like(bent[0], dtype=bool),
+                       np.invert(bent[0]) if bent[0].dtype == bool
+                       else bent[0] + 1, bent[0])
+    assert chunk_digest(*args, counters, bent) != base
+    # divergence propagates through the chain link
+    assert link("", base) != link("", chunk_digest(*args, flip, leaves))
+
+
+def test_chain_incomparable_after_cadence_change():
+    """note_cadence (the OOM-halving hook) marks the chain so it can
+    never be false-positive-compared against a full-cadence chain."""
+    fa = FleetAttest()
+    fa.track(0, 16, start=0)
+    fa.note_cadence(8)
+    halved = fa.payload(0)
+    assert not comparable(halved, _at(chunk_steps=16))
+    assert comparable(_at(), _at(head="b" * 64))
+    assert not heads_equal(_at(), _at(head="b" * 64))
+
+
+def test_checkpoint_restore_resumes_identical_chain(tmp_path):
+    """Crash-resume must re-join the chain exactly: a run checkpointed
+    at chunk k and resumed elsewhere commits the same head as the
+    uninterrupted run (what makes offline replay comparable at all)."""
+    from primesim_tpu.sim.checkpoint import (
+        load_element_checkpoint,
+        save_element_checkpoint,
+    )
+
+    cfg, trace = _trace()
+    straight = FleetEngine(cfg, [trace], [{}], chunk_steps=16)
+    straight.attest = FleetAttest()
+    straight.attest.track(0, 16, start=0)
+    RunSupervisor(straight, handle_signals=False).run(max_steps=100_000)
+
+    first = FleetEngine(cfg, [trace], [{}], chunk_steps=16)
+    first.attest = FleetAttest()
+    first.attest.track(0, 16, start=0)
+    first.step_chunk()
+    first.step_chunk()
+    path = str(tmp_path / "elem.npz")
+    save_element_checkpoint(path, first, 0)
+
+    snap = load_element_checkpoint(path, cfg, trace)
+    at = snap.get("attest")
+    assert at and at["chunks"] == 2 and at["start"] == 0
+    second = FleetEngine(cfg, [trace], [{}], chunk_steps=16)
+    second.restore_element(0, snap)
+    second.attest = FleetAttest()
+    second.attest.track(0, 16, start=at["start"], head=at["head"],
+                        chunks=at["chunks"])
+    RunSupervisor(second, handle_signals=False).run(max_steps=100_000)
+    assert second.attest.payload(0) == straight.attest.payload(0)
+
+
+def test_attest_off_is_bit_exact_and_emits_nothing():
+    """--attest off is the dead branch: `attest` stays None, nothing
+    observes the engines, and the committed outputs are identical to an
+    attested run's (the chain only READS host state)."""
+    cfg, trace = _trace()
+
+    def run(on):
+        fleet = FleetEngine(cfg, [trace], [{}], chunk_steps=16)
+        assert fleet.attest is None  # the default, never flipped by sim
+        if on:
+            fleet.attest = FleetAttest()
+            fleet.attest.track(0, 16, start=0)
+        RunSupervisor(fleet, handle_signals=False).run(max_steps=100_000)
+        ec = fleet.element_counters(0)
+        return {k: int(v.sum()) for k, v in ec.items()} | {
+            "cycles": int(fleet.cycles[0].max()),
+            "steps": int(fleet.steps_run[0]),
+        }
+
+    assert run(False) == run(True)
+
+
+# ---- coordinator: ack attestation, tiebreak, SUSPECT, toolchain ----------
+
+
+def _units(n=2, chunk_steps=16):
+    cfg = _cfg()
+    return cfg, build_units(
+        cfg, [], [SYNTH.format(i) for i in range(n)],
+        [{} for _ in range(n)], fold=True, chunk_steps=chunk_steps,
+        max_steps=100_000,
+    )
+
+
+def _coord(tmp_path, units, **kw):
+    kw.setdefault("lease_ttl_s", 5.0)
+    kw.setdefault("attest", "chain")
+    return PoolCoordinator(units, str(tmp_path / "pool"), **kw)
+
+
+def _lease(coord, worker, toolchain=None):
+    req = {"verb": "lease", "worker": worker}
+    if toolchain is not None:
+        req["toolchain"] = toolchain
+    return coord.handle(req)
+
+
+def _ack(coord, worker, grant, attest=None, audit=False, value=1):
+    u = grant["unit"]
+    req = {
+        "verb": "ack", "worker": worker, "unit_id": u["unit_id"],
+        "epoch": grant["epoch"], "key": u["key"],
+        "result": {"metric": "x", "value": value}, "resumed_steps": 0,
+    }
+    if attest is not None:
+        req["attest"] = attest
+    if audit:
+        req["audit"] = True
+    return coord.handle(req)
+
+
+def test_hedged_twin_mismatch_tiebreak_resolves_and_quarantines(tmp_path):
+    """Two comparable chains disagree -> both held, unit voided back to
+    PENDING barred to both claimants; the third worker's fresh run
+    matches one chain -> DONE with that result, the refuted worker is
+    quarantined as SUSPECT and refused at its next lease."""
+    cfg, units = _units(1)
+    coord = _coord(tmp_path, units)
+    good, bad = _at(), _at(head="b" * 64)
+
+    g1 = _lease(coord, "w1")
+    assert g1.get("attest") == "chain"
+    assert _ack(coord, "w1", g1, attest=good)["accepted"]
+    # hedged twin (or re-dispatched loser) acks with a diverging chain
+    r = coord.handle({
+        "verb": "ack", "worker": "w2",
+        "unit_id": g1["unit"]["unit_id"], "epoch": g1["epoch"],
+        "key": g1["unit"]["key"],
+        "result": {"metric": "x", "value": 2}, "resumed_steps": 0,
+        "attest": bad,
+    })
+    assert r["mismatch"] and coord.counters["attest_mismatches"] == 1
+    uid = g1["unit"]["unit_id"]
+    assert coord.units[uid]["state"] == PENDING
+    assert coord.units[uid]["suspects"] == {"w1", "w2"}
+    # neither claimant may take the tiebreak
+    assert _lease(coord, "w1").get("idle")
+    g3 = _lease(coord, "w3")
+    assert g3["fresh"] and g3["checkpoint"] is None
+    assert _ack(coord, "w3", g3, attest=good)["accepted"]
+    assert coord.units[uid]["state"] == DONE
+    assert coord.suspect_workers == {"w2"}
+    refused = _lease(coord, "w2")
+    assert refused["refused"] == "suspect"
+    assert refused["error"]["type"] == "AttestationError"
+    coord.close(drained=False)
+
+
+def test_three_way_divergence_is_terminal_suspect(tmp_path):
+    """Tiebreak matches neither held chain: the unit itself parks as
+    SUSPECT (terminal, all three payloads preserved in the ledger)."""
+    cfg, units = _units(1)
+    coord = _coord(tmp_path, units)
+    g1 = _lease(coord, "w1")
+    uid = g1["unit"]["unit_id"]
+    _ack(coord, "w1", g1, attest=_at("a" * 64))
+    coord.handle({
+        "verb": "ack", "worker": "w2", "unit_id": uid,
+        "epoch": g1["epoch"], "key": g1["unit"]["key"],
+        "result": {"metric": "x", "value": 2}, "resumed_steps": 0,
+        "attest": _at("b" * 64),
+    })
+    g3 = _lease(coord, "w3")
+    r = _ack(coord, "w3", g3, attest=_at("c" * 64))
+    assert r["suspect"]
+    # terminal SUSPECT is its own state, distinct from POISON
+    assert coord.units[uid]["state"] == SUSPECT
+    assert len(coord.units[uid]["held"]) == 3
+    assert coord.done
+    res = {x["unit_id"]: x for x in coord.results()}
+    assert res[uid]["state"] == "SUSPECT"
+    coord.close(drained=False)
+    # the ledger retains every chain for the offline adjudicator
+    from primesim_tpu.analysis.fsck import _check_journal_dir
+
+    root = str(tmp_path / "pool")
+    records, _ = _check_journal_dir(root, root)
+    verdicts = [x for x in records if x.get("t") == "verdict"]
+    assert verdicts and verdicts[-1]["outcome"] == "unresolved"
+    assert len(verdicts[-1]["held"]) == 3
+
+
+def test_hedged_twin_agreement_confirms(tmp_path):
+    cfg, units = _units(1)
+    coord = _coord(tmp_path, units)
+    g1 = _lease(coord, "w1")
+    _ack(coord, "w1", g1, attest=_at())
+    r = coord.handle({
+        "verb": "ack", "worker": "w2",
+        "unit_id": g1["unit"]["unit_id"], "epoch": g1["epoch"],
+        "key": g1["unit"]["key"], "result": {"metric": "x", "value": 1},
+        "resumed_steps": 0, "attest": _at(),
+    })
+    assert r["duplicate"] and not r.get("mismatch")
+    assert coord.counters["attest_confirms"] == 1
+    assert not coord.suspect_workers
+    coord.close(drained=False)
+
+
+def test_toolchain_mismatch_refused_at_lease(tmp_path):
+    cfg, units = _units(1)
+    coord = _coord(tmp_path, units)
+    ours = toolchain_fingerprint()
+    assert set(ours) == {"jax", "jaxlib", "backend"}
+    ok = _lease(coord, "w1", toolchain=dict(ours))
+    assert ok.get("unit")
+    stale = dict(ours, jaxlib="0.0.0-elsewhere")
+    r = _lease(coord, "w2", toolchain=stale)
+    assert r["refused"] == "toolchain"
+    assert r["error"]["type"] == "AttestationError"
+    assert "jaxlib" in r["error"]["detail"]
+    assert coord.counters["toolchain_refused"] == 1
+    coord.close(drained=False)
+
+
+def test_audit_rate_redispatches_to_other_worker(tmp_path):
+    """--audit-rate 1.0: after w1's ack the next lease from a DIFFERENT
+    worker is an audit re-dispatch of the same unit; its matching ack
+    closes the audit without disturbing the DONE result."""
+    cfg, units = _units(1)
+    coord = _coord(tmp_path, units, audit_rate=1.0)
+    g1 = _lease(coord, "w1")
+    uid = g1["unit"]["unit_id"]
+    _ack(coord, "w1", g1, attest=_at())
+    g2 = _lease(coord, "w2")
+    assert g2.get("audit") and g2["unit"]["unit_id"] == uid
+    assert g2["checkpoint"] is None  # audits replay from scratch
+    r = _ack(coord, "w2", g2, attest=_at(), audit=True)
+    assert r["duplicate"]
+    assert coord.counters["audits"] == 1
+    assert coord.counters["audits_ok"] == 1
+    assert coord.units[uid]["state"] == DONE
+    assert coord.done
+    coord.close(drained=False)
+
+
+def test_attest_off_acks_carry_no_chain(tmp_path):
+    """The chain fields must be absent byte-for-byte when attest is
+    off — even a stray payload in the wire request is dropped."""
+    cfg, units = _units(1)
+    coord = _coord(tmp_path, units, attest="off")
+    g1 = _lease(coord, "w1")
+    assert "attest" not in g1
+    _ack(coord, "w1", g1, attest=_at())  # stray payload is DROPPED
+    coord.close(drained=False)
+    from primesim_tpu.analysis.fsck import _check_journal_dir
+
+    root = str(tmp_path / "pool")
+    records, _ = _check_journal_dir(root, root)
+    acks = [x for x in records if x.get("t") == "ack"]
+    assert acks and all("attest" not in x for x in acks)
+
+
+def test_hedged_loser_ack_retained_even_attest_off(tmp_path):
+    """Satellite: the losing twin's payload lands in the ledger as
+    ack_dup regardless of attestation mode."""
+    cfg, units = _units(1)
+    coord = _coord(tmp_path, units, attest="off")
+    g1 = _lease(coord, "w1")
+    _ack(coord, "w1", g1)
+    coord.handle({
+        "verb": "ack", "worker": "w2",
+        "unit_id": g1["unit"]["unit_id"], "epoch": g1["epoch"],
+        "key": g1["unit"]["key"],
+        "result": {"metric": "x", "value": 9}, "resumed_steps": 0,
+    })
+    coord.close(drained=False)
+    from primesim_tpu.analysis.fsck import _check_journal_dir
+
+    root = str(tmp_path / "pool")
+    records, _ = _check_journal_dir(root, root)
+    dups = [x for x in records if x.get("t") == "ack_dup"]
+    assert len(dups) == 1
+    assert dups[0]["worker"] == "w2"
+    assert dups[0]["result"] == {"metric": "x", "value": 9}
+
+
+# ---- fsck: attestation records -------------------------------------------
+
+
+def test_fsck_attest_record_checks():
+    from primesim_tpu.analysis.fsck import _check_attest_records
+
+    good = _at()
+    recs = [
+        {"t": "ack", "unit_id": "u0", "attest": dict(good, head="zz")},
+        {"t": "verdict", "unit_id": "u1", "outcome": "resolved",
+         "attest": good},
+        {"t": "ack", "unit_id": "u2", "attest": good},
+        {"t": "suspect", "unit_id": "u2",
+         "held": [{"worker": "w1", "attest": _at("b" * 64)}]},
+        {"t": "audit", "unit_id": "u9", "worker": "w0", "ok": True},
+    ]
+    fs = _check_attest_records(recs, "pool", "/nonexistent", "/")
+    details = " | ".join(f.detail for f in fs)
+    assert len(fs) == 4 and all(f.corrupt for f in fs)
+    assert "malformed chain payload" in details
+    assert "no preceding suspect" in details
+    assert "retained evidence was rewritten" in details
+    assert "no acked result" in details
+    # the legal stream raises nothing
+    legal = [
+        {"t": "ack", "unit_id": "u0", "attest": good},
+        {"t": "suspect", "unit_id": "u0",
+         "held": [{"worker": "w1", "attest": good},
+                  {"worker": "w2", "attest": _at("b" * 64)}]},
+        {"t": "verdict", "unit_id": "u0", "outcome": "resolved",
+         "attest": good},
+        {"t": "audit", "unit_id": "u0", "worker": "w3", "ok": True},
+    ]
+    assert _check_attest_records(legal, "pool", "/nonexistent", "/") == []
+
+
+def _checkpointed_fleet(chunks=2):
+    cfg, trace = _trace()
+    fleet = FleetEngine(cfg, [trace], [{}], chunk_steps=16)
+    fleet.attest = FleetAttest()
+    fleet.attest.track(0, 16, start=0)
+    for _ in range(chunks):
+        fleet.step_chunk()
+    return fleet
+
+
+def test_fsck_ack_vs_checkpoint_agreement(tmp_path):
+    """A surviving unit checkpoint whose chain contradicts the acked
+    result is corrupt AND repairable: --repair quarantine moves the npz
+    aside (the ledger, the truth, stays put)."""
+    from primesim_tpu.analysis.fsck import run_fsck
+    from primesim_tpu.sim.checkpoint import save_element_checkpoint
+
+    fleet = _checkpointed_fleet()
+    ck = fleet.attest.payload(0)
+    assert ck["chunks"] == 2
+
+    cfg, units = _units(1)
+    root = str(tmp_path / "pool")
+    coord = PoolCoordinator(units, root, lease_ttl_s=5.0, attest="chain")
+    g = _lease(coord, "w1")
+    uid = g["unit"]["unit_id"]
+    # ack a chain the checkpoint does NOT prefix: same cadence, same
+    # chunk count, different head
+    _ack(coord, "w1", g, attest=dict(ck, head="f" * 64))
+    coord.close(drained=False)
+    ckpt = os.path.join(root, "units", f"{uid}.npz")
+    os.makedirs(os.path.dirname(ckpt), exist_ok=True)
+    save_element_checkpoint(ckpt, fleet, 0)
+
+    res = run_fsck(root)
+    hits = [f for f in res.findings if f.kind == "attest-checkpoint"]
+    assert len(hits) == 1 and hits[0].corrupt and hits[0].repairable
+    assert "disagrees with the acked result" in hits[0].detail
+    res2 = run_fsck(root, repair="quarantine")
+    assert any(q.endswith(f"{uid}.npz") for q in res2.quarantined)
+    assert not os.path.exists(ckpt)
+    ledger = os.path.join(root, "journal.jsonl")
+    assert os.path.exists(ledger)  # the ledger is never moved
+
+
+def test_fsck_clean_on_agreeing_checkpoint(tmp_path):
+    from primesim_tpu.analysis.fsck import run_fsck
+    from primesim_tpu.sim.checkpoint import save_element_checkpoint
+
+    fleet = _checkpointed_fleet()
+    ck = fleet.attest.payload(0)
+    cfg, units = _units(1)
+    root = str(tmp_path / "pool")
+    coord = PoolCoordinator(units, root, lease_ttl_s=5.0, attest="chain")
+    g = _lease(coord, "w1")
+    uid = g["unit"]["unit_id"]
+    _ack(coord, "w1", g, attest=ck)  # ack == checkpoint: a true prefix
+    coord.close(drained=False)
+    ckpt = os.path.join(root, "units", f"{uid}.npz")
+    os.makedirs(os.path.dirname(ckpt), exist_ok=True)
+    save_element_checkpoint(ckpt, fleet, 0)
+    res = run_fsck(root)
+    assert [f for f in res.findings if "attest" in f.kind] == []
+
+
+# ---- offline audit (`primetpu audit`) ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def drained_pool(tmp_path_factory):
+    """One real attested pooled campaign, drained in-process; the
+    module's offline-audit tests all read (never write) this ledger."""
+    from primesim_tpu.chaos.campaign import _pool_drain
+
+    root = str(tmp_path_factory.mktemp("audpool") / "pool")
+    specs = [SYNTH.format(101), SYNTH.format(102)]
+    results, counters, suspects = _pool_drain(
+        root, _cfg(), specs, attest="chain", audit_rate=0.0, n_workers=1)
+    assert all(r["state"] == "DONE" for r in results)
+    assert not suspects
+    return root
+
+
+def test_offline_audit_confirms_clean_campaign(drained_pool):
+    from primesim_tpu.attest.audit import run_audit
+
+    out = run_audit(drained_pool)
+    s = out["summary"]
+    assert s["audited"] == 2 and s["ok"] == 2 and s["mismatch"] == 0
+    for v in out["units"]:
+        assert v["detail"]["ack"] == "confirmed"
+        assert v["detail"]["replay"]["head"]
+
+
+def test_offline_audit_flags_forged_ledger_head(drained_pool, tmp_path):
+    """Rewrite one acked chain head (via a fresh, validly-framed ledger
+    so the chain fsck stays green) -> the replay refuses to confirm."""
+    import shutil
+
+    from primesim_tpu.analysis.fsck import _check_journal_dir
+    from primesim_tpu.attest.audit import run_audit
+    from primesim_tpu.serve.journal import JobJournal
+
+    root = str(tmp_path / "forged")
+    shutil.copytree(drained_pool, root)
+    records, _ = _check_journal_dir(root, root)
+    for seg in os.listdir(root):
+        if seg.startswith("journal"):
+            os.unlink(os.path.join(root, seg))
+    j = JobJournal(root)
+    forged_uid = None
+    for rec in records:
+        if rec.get("t") == "ack" and forged_uid is None:
+            rec = dict(rec)
+            rec["attest"] = dict(rec["attest"], head="e" * 64)
+            forged_uid = rec["unit_id"]
+        j.append(rec)
+    j.close()
+    assert forged_uid is not None
+    out = run_audit(root)
+    assert out["summary"]["mismatch"] == 1
+    bad = {v["unit_id"]: v for v in out["units"]}[forged_uid]
+    assert bad["status"] == "mismatch"
+    assert bad["detail"]["ack"]["journaled_head"] == "e" * 64
+
+
+def test_offline_audit_survives_torn_ledger_tail(drained_pool, tmp_path):
+    """kill -9 debris (a half-written final line) must neither crash the
+    audit nor be repaired by it: the ledger bytes are evidence."""
+    import shutil
+
+    from primesim_tpu.attest.audit import run_audit
+
+    root = str(tmp_path / "torn")
+    shutil.copytree(drained_pool, root)
+    active = os.path.join(root, "journal.jsonl")
+    with open(active, "ab") as f:
+        f.write(b'{"t":"ack","unit_id":"u9')  # torn mid-frame
+    with open(active, "rb") as f:
+        before = f.read()
+    out = run_audit(root)
+    assert out["summary"]["ok"] == 2
+    with open(active, "rb") as f:
+        assert f.read() == before
+
+
+def test_offline_audit_selects_units_and_rejects_unknown(drained_pool):
+    from primesim_tpu.attest.audit import run_audit
+
+    out = run_audit(drained_pool, unit_ids=["u00001"])
+    assert [v["unit_id"] for v in out["units"]] == ["u00001"]
+    with pytest.raises(AttestationError) as ei:
+        run_audit(drained_pool, unit_ids=["nope"])
+    assert ei.value.location()["site"] == "audit.ledger"
+
+
+@pytest.mark.slow  # slow: subprocess CLI; integrity-chaos CI job runs it
+def test_cli_audit_verb_exit_contract(drained_pool):
+    """`primetpu audit` on a clean pool: one JSON verdict line per unit,
+    exit 0; on a missing dir: the typed error contract on exit 2."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "primesim_tpu.cli", "audit", drained_pool],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300,
+    )
+    assert p.returncode == 0, p.stderr
+    lines = [json.loads(ln) for ln in p.stdout.splitlines() if ln.strip()]
+    assert {v["unit_id"] for v in lines} == {"u00000", "u00001"}
+    assert all(v["status"] == "ok" for v in lines)
+
+    p2 = subprocess.run(
+        [sys.executable, "-m", "primesim_tpu.cli", "audit",
+         drained_pool + "-nope"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert p2.returncode == 2
+    err = json.loads(p2.stderr.splitlines()[-1])
+    assert err["error"]["type"] == "AttestationError"
+    assert err["error"]["location"]["site"] == "audit.ledger"
+
+
+# ---- chaos: silent corruption vs invariant F -----------------------------
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_runtime():
+    from primesim_tpu.chaos import sites
+
+    sites.deactivate()
+    yield
+    sites.deactivate()
+
+
+def _flip(site, occ, **args):
+    from primesim_tpu.chaos import plan as P
+
+    return P.FaultEvent(site=site, occurrence=occ, action="flip",
+                        args=tuple(sorted(args.items())))
+
+
+@pytest.mark.slow  # slow: pooled chaos trial; integrity-chaos CI job runs it
+def test_silent_corruption_trial_invariant_f(tmp_path):
+    """A flipped committed counter mid-campaign: invariant F — no
+    corrupted result reaches DONE unflagged — must hold, and the trial
+    must actually have injected the flip it claims to test."""
+    from primesim_tpu.chaos import campaign as C
+    from primesim_tpu.chaos import plan as P
+
+    plan = P.FaultPlan(seed=11, events=(
+        _flip("fleet.counters", 1),
+    ))
+    res = C.run_attest_trial(plan, workdir=str(tmp_path))
+    assert res.ok, res.violations
+    assert any(e["site"] == "fleet.counters" for e in res.injected)
+
+
+@pytest.mark.slow  # slow: pooled chaos trial; integrity-chaos CI job runs it
+def test_silent_corruption_clean_plan_zero_false_positives(tmp_path):
+    """The dual: a trial where no flip fires must end with every unit
+    DONE, zero mismatches, zero SUSPECTs, zero quarantined workers."""
+    from primesim_tpu.chaos import campaign as C
+    from primesim_tpu.chaos import plan as P
+
+    res = C.run_attest_trial(P.FaultPlan(seed=12, events=()),
+                             workdir=str(tmp_path))
+    assert res.ok, res.violations
+    assert res.injected == []
+
+
+@pytest.mark.slow
+def test_silent_corruption_seeded_campaign(tmp_path):
+    """CI shape: a seeded silent_corruption campaign where every flip
+    that fires is flagged and no clean trial raises a false positive."""
+    from primesim_tpu.chaos import campaign as C
+
+    rep = C.run_campaign(n_trials=6, seed0=2026,
+                         classes=("silent_corruption",),
+                         workdir=str(tmp_path))
+    assert rep["ok"], rep["violations"]
+    assert rep["trials"] == 6
+
+
+@pytest.mark.slow
+def test_offline_audit_after_kill9_campaign(tmp_path):
+    """SIGKILL the whole pooled campaign mid-flight, then audit the
+    surviving ledger offline: DONE units replay and confirm, in-flight
+    units are skipped, nothing crashes, nothing is mutated."""
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        f.write(_cfg().to_json())
+    pool = str(tmp_path / "pool")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "primesim_tpu.cli", "sweep", cfg_path,
+         "--synth", SYNTH.format(1), "--synth", SYNTH.format(2),
+         "--synth", SYNTH.format(3), "--synth", SYNTH.format(4),
+         "--workers", "1", "--pool-dir", pool, "--attest", "chain",
+         "--chunk-steps", "16", "--max-steps", "100000", "--hedge",
+         "off"],
+        cwd=REPO, env=env, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + 240
+    done = 0
+    try:
+        while time.time() < deadline:
+            time.sleep(0.5)
+            if proc.poll() is not None:
+                break
+            try:
+                from primesim_tpu.analysis.fsck import _check_journal_dir
+
+                records, _ = _check_journal_dir(pool, pool)
+                done = sum(1 for r in records if r.get("t") == "ack")
+            except Exception:
+                continue
+            if done >= 1:
+                os.killpg(proc.pid, signal.SIGKILL)
+                break
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    assert done >= 1, "campaign never acked a unit before the deadline"
+
+    from primesim_tpu.attest.audit import run_audit
+
+    out = run_audit(pool)
+    s = out["summary"]
+    assert s["mismatch"] == 0
+    assert s["ok"] >= done
