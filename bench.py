@@ -510,6 +510,58 @@ def main() -> None:
             "passed": bool(attest_overhead_pct < 3.0),
         }
 
+    # calibration economics (DESIGN.md §25): a full `primetpu calibrate`
+    # self-test fit — synthesize observed values at known truth knobs,
+    # then pattern-search two knobs back from the config defaults. Every
+    # fleet dispatch shares ONE compiled program (constant candidate x
+    # entry batch), so the wall clock prices compile-once + N cache-hit
+    # dispatches. Advisory gate: the fit must actually recover the truth
+    # (cost ~ 0). PRIMETPU_BENCH_CALIB=0 skips (metric and gate null).
+    calib_detail = None
+    calib_gate = None
+    if os.environ.get("PRIMETPU_BENCH_CALIB", "1") != "0":
+        from primesim_tpu.calib.fit import fit as calib_fit
+        from primesim_tpu.calib.fit import synthesize_observed
+        from primesim_tpu.calib.table import CalibEntry, CalibTable
+        from primesim_tpu.config.machine import small_test_config
+
+        ccfg = small_test_config(8, n_banks=4, quantum=500)
+        ctable = CalibTable(
+            name="bench_selftest",
+            entries=(
+                CalibEntry("chase", "pointer_chase",
+                           {"n_mem_ops": 48, "n_nodes": 16},
+                           "cycles_per_mem_op", 1.0),
+                CalibEntry("xchg", "uniform_random",
+                           {"n_mem_ops": 48, "shared_frac": 1, "seed": 1},
+                           "cycles_per_mem_op", 1.0),
+            ),
+        )
+        truth = {"llc_lat": 16, "dram_lat": 151}
+        ctable = synthesize_observed(ccfg, ctable, truth, chunk_steps=64)
+        t0 = time.perf_counter()
+        cres = calib_fit(ccfg, ctable, fit_keys=tuple(truth),
+                         chunk_steps=64)
+        calib_wall = time.perf_counter() - t0
+        calib_detail = {
+            "fit_keys": sorted(truth),
+            "truth": truth,
+            "knobs": cres.knobs,
+            "cost": cres.cost,
+            "rounds": cres.rounds,
+            "fleet_runs": cres.fleet_runs,
+            "batch": cres.batch,
+            "wall_s": round(calib_wall, 2),
+            "wall_ms_per_dispatch": round(
+                calib_wall * 1000.0 / max(1, cres.fleet_runs), 1
+            ),
+        }
+        calib_gate = {
+            "max_cost": 1e-6,
+            "hard": False,
+            "passed": bool(cres.cost <= 1e-6),
+        }
+
     # LIVE per-phase cuts (scripts/prof/prof_phase.py source surgery) on
     # elastic pool scaling (DESIGN.md §17): the same 16-element campaign
     # through `sweep --workers 1` vs `--workers 3` — real worker
@@ -798,6 +850,13 @@ def main() -> None:
                         attest_detail["overhead_pct"]
                         if attest_detail else None
                     ),
+                    # wall clock of a full 2-knob calibrate self-test
+                    # fit over one compiled fleet (null when
+                    # PRIMETPU_BENCH_CALIB=0; advisory gate: truth
+                    # recovered with ~zero residual)
+                    "calibrate_sweep_wall_s": (
+                        calib_detail["wall_s"] if calib_detail else None
+                    ),
                 },
                 "detail": {
                     "n_cores": C,
@@ -836,6 +895,11 @@ def main() -> None:
                     # when PRIMETPU_BENCH_ATTEST=0)
                     "attest_overhead": attest_detail,
                     "attest_overhead_gate": attest_gate,
+                    # calibration economics (DESIGN.md §25): self-test
+                    # fit wall over one compiled constant-shape fleet
+                    # (null when PRIMETPU_BENCH_CALIB=0)
+                    "calibrate_sweep": calib_detail,
+                    "calibrate_sweep_gate": calib_gate,
                     # aggregate MIPS batching B sims through one program
                     # (rung-1/64-core config, one distinct trace per
                     # element)

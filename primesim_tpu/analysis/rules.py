@@ -41,13 +41,26 @@ from .lint import rule
 # process; test_analysis.py asserts the mirror stays in sync.
 KNOB_FIELDS = frozenset({
     "quantum", "cpi", "l1_lat", "llc_lat", "link_lat", "router_lat",
-    "dram_lat", "dram_service", "contention_lat",
+    "dram_lat", "dram_service", "contention_lat", "prefetch_degree",
+    "prefetch_lat",
 })
 FAULT_FIELDS = frozenset({
     "seed", "core_dead", "link_dead", "link_extra", "ev_step",
     "ev_kind", "ev_a", "ev_b", "flip_l1", "flip_llc", "due_rate",
 })
 TRACED_FIELDS = KNOB_FIELDS | FAULT_FIELDS
+
+# Static zoo selectors (DESIGN.md §25): string-valued config fields that
+# pick a compiled variant and ride the jit/exec-cache key via
+# timing_normalized. The inverse contract of TRACED_FIELDS — these must
+# branch in PYTHON (`if cfg.coherence == ...`), never inside traced
+# select ops, or both variants compile into one program and the static
+# key stops meaning anything.
+SELECTOR_FIELDS = frozenset({
+    "topology", "coherence", "prefetcher", "contention_model",
+    "step_impl",
+})
+_TRACED_SELECTS = {"where", "select", "select_n", "cond", "switch"}
 
 _HOST_CASTS = {"bool", "float", "int"}
 _DYNSHAPE_OPS = {"nonzero", "flatnonzero", "unique", "argwhere"}
@@ -89,6 +102,25 @@ def check_traced_branch(tree, ctx):
                             f"host cast `{node.func.id}()` on traced "
                             f"field `.{a.attr}` — forces a device sync "
                             "and bakes the knob into host state"
+                        )
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _TRACED_SELECTS
+            and ast.unparse(node.func.value)
+            in ("jnp", "np", "jax.numpy", "lax", "jax.lax")
+        ):
+            for arg in node.args:
+                for a in ast.walk(arg):
+                    if (
+                        isinstance(a, ast.Attribute)
+                        and a.attr in SELECTOR_FIELDS
+                    ):
+                        hits[(a.lineno, a.col_offset)] = (
+                            f"static selector `.{a.attr}` inside traced "
+                            f"`{node.func.attr}` — zoo selectors are jit-"
+                            "key statics; branch in Python so only the "
+                            "selected variant compiles"
                         )
     for (lineno, col), msg in sorted(hits.items()):
         yield lineno, col, msg

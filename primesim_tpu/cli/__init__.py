@@ -1161,6 +1161,117 @@ def cmd_info(ns) -> int:
     return 0
 
 
+def cmd_calibrate(ns) -> int:
+    """Fit traced timing knobs to a published microbenchmark table
+    (DESIGN.md §25): coordinate-descent pattern search where every
+    candidate set runs as ONE constant-shape fleet — the whole fit
+    compiles once per geometry. Emits one `calibrate_residual` JSON line
+    per table entry plus a final `calibrate_fit` line; `--selftest`
+    replaces the observed column with values simulated at ground-truth
+    knobs and asserts the fit recovers them (exit 1 if not)."""
+    from ..calib.fit import (
+        FIT_KEYS_DEFAULT, apply_fit, check_fit_keys, fit, knob_start,
+        synthesize_observed,
+    )
+    from ..calib.table import load_table
+
+    cfg = _load_config(ns.config)
+    table = load_table(ns.table)
+    fit_keys = (
+        check_fit_keys(k.strip() for k in ns.fit.split(","))
+        if ns.fit else FIT_KEYS_DEFAULT
+    )
+    truth = None
+    if ns.selftest:
+        # ground truth: explicit --truth overrides, else a deterministic
+        # perturbation of the config's own knobs (so the search must
+        # genuinely move to recover them)
+        truth = (
+            {k: int(v) for k, v in _parse_vary(ns.truth).items()}
+            if ns.truth
+            else {
+                k: v + max(1, v // 2)
+                for k, v in knob_start(cfg, fit_keys).items()
+            }
+        )
+        check_fit_keys(truth.keys())
+        table = synthesize_observed(
+            cfg, table, truth, chunk_steps=ns.chunk_steps
+        )
+    t0 = time.perf_counter()
+    res = fit(
+        cfg, table, fit_keys=fit_keys, max_rounds=ns.rounds,
+        chunk_steps=ns.chunk_steps,
+        log=(lambda s: print(f"calibrate: {s}", file=sys.stderr))
+        if ns.verbose else None,
+    )
+    wall = time.perf_counter() - t0
+    for name, sim, obs, r in res.residuals:
+        print(
+            json.dumps(
+                {
+                    "metric": "calibrate_residual",
+                    "value": round(r, 6),
+                    "unit": "relative",
+                    "detail": {
+                        "entry": name,
+                        "simulated": round(sim, 4),
+                        "observed": round(obs, 4),
+                        "table": table.name,
+                    },
+                }
+            )
+        )
+    detail = {
+        "table": table.name,
+        "fit_keys": list(fit_keys),
+        "knobs": res.knobs,
+        "start": res.start,
+        "rounds": res.rounds,
+        "fleet_runs": res.fleet_runs,
+        "batch": res.batch,
+        "wall_s": round(wall, 3),
+    }
+    if truth is not None:
+        detail["truth"] = truth
+        # exact knob equality is informational (latency knobs can trade
+        # off degenerately, e.g. link vs router on fixed-hop entries);
+        # the self-test CONTRACT is ~zero residual at the fitted point
+        detail["recovered"] = all(
+            res.knobs[k] == v for k, v in truth.items()
+        )
+        detail["selftest_ok"] = res.cost <= ns.tol
+    print(
+        json.dumps(
+            {
+                "metric": "calibrate_fit",
+                "value": round(res.cost, 8),
+                "unit": "sum_sq_rel_residual",
+                "detail": detail,
+            }
+        )
+    )
+    if ns.out:
+        report = res.report()
+        report["table"] = table.name
+        report["config"] = apply_fit(cfg, res.knobs).to_json()
+        if truth is not None:
+            report["truth"] = truth
+        with open(ns.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"calibration report written to {ns.out}", file=sys.stderr)
+    if truth is not None and not detail["selftest_ok"]:
+        print(
+            f"calibrate: SELFTEST FAILED — residual cost {res.cost:.3g} "
+            f"> tol {ns.tol:.3g} (truth {truth}, fitted "
+            f"{ {k: res.knobs[k] for k in truth} })",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def cmd_lint(ns) -> int:
     from ..analysis.lint import render_human, render_json, run_lint
 
@@ -2378,6 +2489,57 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--verbose", action="store_true",
                     help="per-trial progress on stderr")
     ch.set_defaults(fn=cmd_chaos)
+
+    ca = sub.add_parser(
+        "calibrate",
+        help="fit traced timing knobs to a published microbenchmark "
+             "latency/bandwidth table (DESIGN.md §25): coordinate-"
+             "descent pattern search run as constant-shape fleets — "
+             "one compile per geometry",
+    )
+    ca.add_argument("config", help="machine config JSON/XML")
+    ca.add_argument(
+        "--table", required=True, metavar="FILE",
+        help="calibration table JSON (e.g. "
+             "configs/calib_ipu_microbench.json)",
+    )
+    ca.add_argument(
+        "--fit", default=None, metavar="K1,K2,...",
+        help="comma list of knobs to fit (default cpi,l1_lat,llc_lat,"
+             "link_lat,router_lat,dram_lat)",
+    )
+    ca.add_argument(
+        "--rounds", type=int, default=24,
+        help="max coordinate-descent rounds (default 24)",
+    )
+    ca.add_argument(
+        "--chunk-steps", type=int, default=256,
+        help="fleet chunk size in steps (default 256)",
+    )
+    ca.add_argument(
+        "--out", default=None, metavar="FILE",
+        help="write the full fit report (knobs, residuals, fitted "
+             "config) as JSON",
+    )
+    ca.add_argument(
+        "--selftest", action="store_true",
+        help="replace the observed column with values simulated at "
+             "ground-truth knobs and require the fit to recover them "
+             "with ~zero residual (exit 1 otherwise)",
+    )
+    ca.add_argument(
+        "--truth", default=None, metavar="K=V,...",
+        help="selftest ground-truth knobs (default: a deterministic "
+             "perturbation of the config's own values)",
+    )
+    ca.add_argument(
+        "--tol", type=float, default=1e-6,
+        help="selftest pass threshold on the summed squared relative "
+             "residual (default 1e-6)",
+    )
+    ca.add_argument("--verbose", action="store_true",
+                    help="per-coordinate-step progress on stderr")
+    ca.set_defaults(fn=cmd_calibrate)
     return p
 
 
@@ -2391,16 +2553,17 @@ def main(argv=None) -> int:
     ns = build_parser().parse_args(argv)
     from ..analysis.errors import AnalysisError, FsckCorrupt
     from ..attest.errors import AttestationError
-    from ..config.machine import FaultConfigError
+    from ..calib.table import CalibError
+    from ..config.machine import ConfigError, FaultConfigError
     from ..parallel.sharding import DeviceMeshError
     from ..sim.checkpoint import CheckpointCorrupt
     from ..trace.format import TraceError
 
     try:
         return ns.fn(ns)
-    except (TraceError, FaultConfigError, CheckpointCorrupt, VarySpecError,
-            AnalysisError, FsckCorrupt, DeviceMeshError,
-            AttestationError) as e:
+    except (TraceError, ConfigError, FaultConfigError, CheckpointCorrupt,
+            VarySpecError, AnalysisError, FsckCorrupt, DeviceMeshError,
+            AttestationError, CalibError) as e:
         # typed errors exit 2 with ONE structured JSON line on stderr —
         # {"error": {type, location, detail}} — the same shape the serve
         # protocol and sweep quarantine lines use, so scripts parse one

@@ -65,6 +65,55 @@ class FaultConfigError(ValueError):
         return out
 
 
+class ConfigError(ValueError):
+    """A machine-zoo model selector is unknown or the combination is
+    incompatible (DESIGN.md §25).
+
+    Mirrors FaultConfigError / parallel.sharding.DeviceMeshError: the CLI
+    catches it, exits 2 and prints ONE structured `{"error": ...}` JSON
+    line, so `topology="taurus"` fails at config load with a typed
+    message instead of a mid-compile shape error.
+
+    `selector` names the offending config field ("noc_topology",
+    "coherence", "prefetcher"), `value` its rejected value.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        selector: str | None = None,
+        value=None,
+    ):
+        self.selector = selector
+        self.value = value
+        where = []
+        if selector is not None:
+            where.append(str(selector))
+        if value is not None:
+            where.append(f"value {value!r}")
+        prefix = (
+            f"machine config: {', '.join(where)}: " if where
+            else "machine config: "
+        )
+        super().__init__(prefix + message)
+
+    def location(self) -> dict:
+        """Non-None locator fields, for structured error lines."""
+        out = {}
+        if self.selector is not None:
+            out["selector"] = self.selector
+        if self.value is not None:
+            out["value"] = str(self.value)
+        return out
+
+
+#: Valid static model-selector values (the machine zoo, DESIGN.md §25).
+NOC_TOPOLOGIES = ("mesh", "torus", "ring")
+COHERENCE_PROTOCOLS = ("mesi", "moesi")
+PREFETCHERS = ("none", "stride")
+
+
 #: Fault event kinds (config/schedule encoding; see faults/schedule.py)
 FAULT_CORE_FAILSTOP = 1  # a = core id: fail-stop at the scheduled step
 FAULT_LINK_FAIL = 2  # a = directed link id: permanent link failure
@@ -184,6 +233,14 @@ class NocConfig:
     contention: bool = False
     contention_model: str = "tile"  # "tile" | "link" | "router"
     contention_lat: int = 1  # queueing cycles per concurrent transaction
+    # STATIC topology selector (DESIGN.md §25): "mesh" (XY dimension-
+    # ordered), "torus" (wrap-around XY, shorter way per ring) or "ring"
+    # (one ring per row bridged by a column-0 spine ring). Part of
+    # `timing_normalized()` like contention_model — it changes the
+    # compiled route builder, never a traced value — so it joins the
+    # jit / exec-cache key. All topologies share the mesh link numbering
+    # (tile*4 + dir), keeping n_links and every scatter shape invariant.
+    topology: str = "mesh"
 
     @property
     def n_tiles(self) -> int:
@@ -264,6 +321,25 @@ class MachineConfig:
     # key but timing knobs stay traced — fleet sweeps still compile once.
     # On non-TPU backends the kernels run in Pallas interpreter mode.
     step_impl: str = "xla"
+    # ---- machine zoo selectors (DESIGN.md §25) --------------------------
+    # STATIC coherence selector: "mesi" (the default pull-based protocol)
+    # or "moesi" — adds the Owned state: a GETS to a modified line leaves
+    # the dirty copy with its owner (no downgrade writeback) while other
+    # sharers are recorded; O is DERIVED from the directory (owner == c
+    # with other sharers), never stored in the L1 plane, so the state
+    # encoding and every kernel layout are unchanged. Requires
+    # sharer_group == 1 (a coarse group bit cannot distinguish the owner
+    # from its own group's other cores).
+    coherence: str = "mesi"
+    # STATIC per-core prefetcher selector: "none" or "stride" (a stride-
+    # detecting line prefetcher trained on each core's arbitrated uncore
+    # stream; hits replace the DRAM latency of an LLC miss with the
+    # traced `prefetch_lat`). The DEGREE and latency are TRACED knobs
+    # (TimingKnobs.prefetch_degree / prefetch_lat) so a calibrate/sweep
+    # fan over them never recompiles.
+    prefetcher: str = "none"
+    prefetch_degree: int = 4  # lines ahead a trained stream covers
+    prefetch_lat: int = 0  # cycles an LLC miss costs on a prefetch hit
     # ---- fault injection (DESIGN.md §12) --------------------------------
     # `faults_enabled` is a STATIC model selector: when False (default)
     # the step function never touches the fault state and the compiled
@@ -320,6 +396,39 @@ class MachineConfig:
             )
         if self.noc.mesh_x < 1 or self.noc.mesh_y < 1:
             raise ValueError("mesh dims must be >= 1")
+        if self.noc.topology not in NOC_TOPOLOGIES:
+            raise ConfigError(
+                f"unknown NoC topology (have: {', '.join(NOC_TOPOLOGIES)})",
+                selector="noc_topology", value=self.noc.topology,
+            )
+        if self.coherence not in COHERENCE_PROTOCOLS:
+            raise ConfigError(
+                "unknown coherence protocol (have: "
+                f"{', '.join(COHERENCE_PROTOCOLS)})",
+                selector="coherence", value=self.coherence,
+            )
+        if self.coherence == "moesi" and self.sharer_group > 1:
+            raise ConfigError(
+                "moesi requires sharer_group == 1: the derived Owned "
+                "state needs exact sharer identity, which a coarse "
+                "group bit cannot provide",
+                selector="coherence", value="moesi",
+            )
+        if self.prefetcher not in PREFETCHERS:
+            raise ConfigError(
+                f"unknown prefetcher (have: {', '.join(PREFETCHERS)})",
+                selector="prefetcher", value=self.prefetcher,
+            )
+        if self.prefetch_degree < 1:
+            raise ConfigError(
+                "prefetch_degree must be >= 1",
+                selector="prefetch_degree", value=self.prefetch_degree,
+            )
+        if self.prefetch_lat < 0:
+            raise ConfigError(
+                "prefetch_lat must be >= 0",
+                selector="prefetch_lat", value=self.prefetch_lat,
+            )
         if not (0 <= self.local_run_len <= 64):
             raise ValueError("local_run_len must be in [0, 64]")
         if not _is_pow2(self.lock_slots):
@@ -405,7 +514,18 @@ class MachineConfig:
                         f"link id {a} out of range [0, {nl})",
                         site=f"link:{a}", step=estep, field="fault_events",
                     )
-                if self.noc.mesh_x < 2 or self.noc.mesh_y < 2:
+                if self.noc.topology == "ring":
+                    # a ring's only fallback is the LONG way around the
+                    # affected ring (noc/ring.py detour_hops_table), which
+                    # needs >= 3 positions to exist
+                    if self.noc.mesh_x < 3 or self.noc.mesh_y < 3:
+                        raise FaultConfigError(
+                            "ring link faults need mesh_x >= 3 and "
+                            "mesh_y >= 3 (the detour is the long way "
+                            "around the affected ring)",
+                            site=f"link:{a}", step=estep, field="noc",
+                        )
+                elif self.noc.mesh_x < 2 or self.noc.mesh_y < 2:
                     raise FaultConfigError(
                         "link faults need a >= 2x2 mesh (the X-Y fallback "
                         "detours around the failed hop through an "
@@ -443,6 +563,10 @@ class MachineConfig:
             ),
             dram_lat=1,
             dram_service=0,
+            # traced prefetcher knobs blank too (they ride in
+            # state.TimingKnobs); the `prefetcher` SELECTOR survives
+            prefetch_degree=1,
+            prefetch_lat=1,
             # traced fault knobs blank out too (seed/schedule/rates ride
             # in state.FaultState); the STATIC selectors (faults_enabled,
             # max_fault_events, policies) survive — they change the graph
@@ -478,7 +602,8 @@ class MachineConfig:
 
     @staticmethod
     def from_dict(d: dict) -> "MachineConfig":
-        d = dict(d)
+        # keys starting with "_" are annotations ("_comment"), not fields
+        d = {k: v for k, v in d.items() if not k.startswith("_")}
         if "core" in d and isinstance(d["core"], dict):
             c = dict(d["core"])
             if c.get("cpi_per_core") is not None:

@@ -18,7 +18,7 @@ from ..config.machine import (
     FAULT_LINK_FAIL,
     MachineConfig,
 )
-from ..noc.mesh import path_links
+from ..noc.topology import detour_hops_table, path_links
 from ..sim.state import llc_meta_width
 from .prng import DUE_SALT, site_hash
 
@@ -166,17 +166,22 @@ def scrub_dead_cond(cfg: MachineConfig, dirm, lock_holder, kill_now):
 def leg_fault_penalty(cfg: MachineConfig, fs, kn, atile, btile):
     """Vectorized fault penalty of the one-way legs atile -> btile:
     (extra cycles, extra hops, rerouted 0/1) per lane — the traced twin
-    of `noc.mesh.detour_stats` (each dead link on the XY path detours at
-    +2 hops and +2*(link+router) cycles; each live degraded link adds its
-    extra cycles)."""
+    of `noc.topology.detour_stats`. Each dead link on the route detours
+    at the TOPOLOGY's per-link extra-hop cost (mesh/torus: the orthogonal
+    sidestep, +2 everywhere; ring: the long way around the affected
+    ring), paying (link+router) per extra hop; each live degraded link
+    adds its extra cycles. The table is a host-side constant baked per
+    geometry, so fault sweeps still compile once."""
     p = path_links(cfg, atile, btile)  # [C, H]
     ok = p >= 0
     pc = jnp.where(ok, p, 0)
+    tbl = jnp.asarray(detour_hops_table(cfg), jnp.int32)
     dead = jnp.where(ok, fs.link_dead[pc], 0)
+    dh = jnp.where(ok, tbl[pc] * dead, 0)  # extra hops per dead link
     extra = jnp.where(ok & (dead == 0), fs.link_extra[pc], 0)
-    d = jnp.sum(dead, axis=1)
-    lat = d * 2 * (kn.link_lat + kn.router_lat) + jnp.sum(extra, axis=1)
-    return lat, 2 * d, (d > 0).astype(jnp.int32)
+    d = jnp.sum(dh, axis=1)
+    lat = d * (kn.link_lat + kn.router_lat) + jnp.sum(extra, axis=1)
+    return lat, d, (jnp.sum(dead, axis=1) > 0).astype(jnp.int32)
 
 
 __all__ = [

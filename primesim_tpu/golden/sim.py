@@ -17,14 +17,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..config.machine import MachineConfig
-from ..noc.mesh import (
-    bank_tile,
-    core_tile,
-    hops as _hops,
-    n_links,
-    one_way_lat,
-    xy_links,
-)
+from ..noc import topology as _topo
+from ..noc.mesh import bank_tile, core_tile, n_links
 from ..stats.counters import zero_counters
 from ..trace.format import (
     EV_BARRIER,
@@ -37,8 +31,9 @@ from ..trace.format import (
     Trace,
 )
 
-# MESI encoding shared with the JAX engine
-I, S, E, M = 0, 1, 2, 3
+# MESI encoding shared with the JAX engine; O (MOESI) is DERIVED — never
+# stored in l1_state, only classified from the home directory's view
+I, S, E, M, O = 0, 1, 2, 3, 4
 
 
 class GoldenSim:
@@ -78,6 +73,12 @@ class GoldenSim:
         # clock, carried across steps
         self.dram_free = np.zeros(B, dtype=np.int64)
 
+        # stride-prefetcher training state (DESIGN.md §25; idle under
+        # prefetcher "none" — mirrors MachineState.pf_*)
+        self.pf_line = np.zeros(C, dtype=np.int64)
+        self.pf_stride = np.zeros(C, dtype=np.int64)
+        self.pf_streak = np.zeros(C, dtype=np.int64)
+
         # synchronization state (DESIGN.md §3 phase 2.7)
         self.lock_holder = np.full(cfg.lock_slots, -1, dtype=np.int64)
         self.barrier_count = np.zeros(cfg.barrier_slots, dtype=np.int64)
@@ -116,6 +117,54 @@ class GoldenSim:
     def _clear_sharers(self, b, s, w):
         self.sharers[b, s, w, :] = 0
 
+    def _derived_owned(self, c: int, line: int) -> bool:
+        """MOESI derived-O test (DESIGN.md §25): core c's stored E/M line
+        is effectively Owned when the home directory still names c owner
+        WITH other sharers recorded (a GETS left the dirty copy in
+        place). O is never stored — reads stay local, stores must
+        arbitrate as upgrades to invalidate the sharers. Directory rows
+        are unwritten between classification and phase 3, so the live
+        read here equals the engine's step-start row."""
+        if self.cfg.coherence != "moesi":
+            return False
+        b, bs = self._bank(line), self._bank_set(line)
+        for wy in range(self.cfg.llc.ways):
+            if self.llc_tag[b, bs, wy] == line:
+                if self.llc_owner[b, bs, wy] != c:
+                    return False
+                shl = self._sharers_from(self.sharers, b, bs, wy)
+                return any(t != c for t in shl)
+        return False
+
+    def _pf_hit(self, c: int, line: int) -> bool:
+        """Stride-prefetch coverage test on core c's STEP-ENTRY training
+        state (DESIGN.md §25): the line sits 1..prefetch_degree confirmed
+        strides (streak >= 2) ahead of the last trained access. Safe to
+        read live: only c's own winner/join trains c's state, and that
+        happens after this test."""
+        if self.cfg.prefetcher != "stride":
+            return False
+        s = int(self.pf_stride[c])
+        if s == 0 or int(self.pf_streak[c]) < 2:
+            return False
+        delta = line - int(self.pf_line[c])
+        q, rem = divmod(delta, s)  # floor semantics, same as the engine
+        return rem == 0 and 1 <= q <= self.cfg.prefetch_degree
+
+    def _pf_train(self, c: int, line: int) -> None:
+        """Train the stride detector on a retired uncore access (winners
+        + joins only — retries re-observe the same line and must not
+        retrain; local L1 hits never reach the uncore)."""
+        if self.cfg.prefetcher != "stride":
+            return
+        ns = line - int(self.pf_line[c])
+        if ns == int(self.pf_stride[c]) and ns != 0:
+            self.pf_streak[c] += 1
+        else:
+            self.pf_streak[c] = 1
+        self.pf_stride[c] = ns
+        self.pf_line[c] = line
+
     def _lock_slot(self, line: int) -> int:
         """Mutex LINE index -> lock-table slot (events are line-granular)."""
         return line & (self.cfg.lock_slots - 1)
@@ -123,18 +172,29 @@ class GoldenSim:
     def _lock_home_tile(self, line: int) -> int:
         return bank_tile(self._bank(line), self.cfg)
 
+    # topology dispatch (DESIGN.md §25): every hop count, one-way latency
+    # and route in the golden model goes through noc/topology.py, so the
+    # torus/ring plugins are oracle-checked by the same parity suite
+    def _thops(self, tile_a: int, tile_b: int) -> int:
+        return int(_topo.hops(self.cfg, tile_a, tile_b, xp=np))
+
+    def _owl(self, tile_a: int, tile_b: int) -> int:
+        return int(_topo.one_way_lat(self.cfg, tile_a, tile_b))
+
+    def _links(self, tile_a: int, tile_b: int) -> list[int]:
+        return list(_topo.route_links(self.cfg, tile_a, tile_b))
+
     def _noc(self, c: int, tile_a: int, tile_b: int):
         """Charge one message tile_a->tile_b to core c's NoC counters."""
-        lat = one_way_lat(tile_a, tile_b, self.cfg)
+        lat = self._owl(tile_a, tile_b)
         self.counters["noc_msgs"][c] += 1
-        self.counters["noc_hops"][c] += _hops(tile_a, tile_b, self.cfg.noc.mesh_x)
+        self.counters["noc_hops"][c] += self._thops(tile_a, tile_b)
         return lat
 
     def _txn_path(self, ctile: int, htile: int, round_trip: bool) -> list[int]:
-        mx = self.cfg.noc.mesh_x
-        p = xy_links(ctile, htile, mx)
+        p = self._links(ctile, htile)
         if round_trip:
-            p = p + xy_links(htile, ctile, mx)
+            p = p + self._links(htile, ctile)
         return p
 
     def _contention_extra(
@@ -204,11 +264,10 @@ class GoldenSim:
     def _route_rt(self, c: int, t0: int, htile: int, service: int) -> int:
         """Round-trip request->service->reply through the router, keyed
         by core c's recorded step-entry key. Returns completion time."""
-        mx = self.cfg.noc.mesh_x
         ctile = core_tile(c, self.cfg)
         key = self._rtr_key[c]
-        t = self._route(t0, xy_links(ctile, htile, mx), key)
-        return self._route(t + service, xy_links(htile, ctile, mx), key)
+        t = self._route(t0, self._links(ctile, htile), key)
+        return self._route(t + service, self._links(htile, ctile), key)
 
     def _rtr_end(self) -> None:
         for l, d in self._rtr_departs:
@@ -293,8 +352,11 @@ class GoldenSim:
                         break
                 if w < 0:
                     break  # miss: stop the run, arbitrate below
-                if t == EV_ST and self.l1_state[c, s, w] not in (E, M):
-                    break  # held in S: upgrade request, arbitrate below
+                if t == EV_ST and (
+                    self.l1_state[c, s, w] not in (E, M)
+                    or self._derived_owned(c, line)
+                ):
+                    break  # held in S (or derived O): upgrade, arbitrate
                 self.cycles[c] += pre * int(self.cpi[c]) + cfg.l1.latency
                 self.counters["instructions"][c] += pre + 1
                 if t == EV_LD:
@@ -372,14 +434,18 @@ class GoldenSim:
                 else:
                     requests.append((int(self.cycles[c]), c, GETS, line, pre))
             else:  # EV_ST
-                if w >= 0 and l1_state0[c, s, w] in (E, M):  # write hit
+                if (
+                    w >= 0
+                    and l1_state0[c, s, w] in (E, M)
+                    and not self._derived_owned(c, line)
+                ):  # write hit (E/M exactly — derived O must arbitrate)
                     self.cycles[c] += pre * int(self.cpi[c]) + cfg.l1.latency
                     self.counters["l1_write_hits"][c] += 1
                     self.counters["instructions"][c] += pre + 1
                     self.l1_state[c, s, w] = M  # silent E->M, phase A local
                     self.l1_lru[c, s, w] = step
                     self.ptr[c] += 1
-                elif w >= 0:  # held in S -> upgrade
+                elif w >= 0:  # held in S (or derived O) -> upgrade
                     requests.append((int(self.cycles[c]), c, UPG, line, pre))
                 else:
                     requests.append((int(self.cycles[c]), c, GETM, line, pre))
@@ -430,7 +496,6 @@ class GoldenSim:
         if cfg.noc.contention:
             link_model = cfg.noc.contention_model == "link"
             router = cfg.noc.contention_model == "router"
-            mx = cfg.noc.mesh_x
             c_hop = cfg.noc.link_lat + cfg.noc.router_lat
             r_lat = cfg.noc.router_lat
 
@@ -444,12 +509,12 @@ class GoldenSim:
                     # be longer; `base` is a min, so early is safe).
                     self._rtr_key[c] = key
                     ctile = core_tile(c, cfg)
-                    req = xy_links(ctile, htile, mx)
+                    req = self._links(ctile, htile)
                     legs = [(req, t0)]
                     if round_trip:
                         legs.append(
                             (
-                                xy_links(htile, ctile, mx),
+                                self._links(htile, ctile),
                                 t0
                                 + r_lat
                                 + len(req) * c_hop
@@ -536,11 +601,13 @@ class GoldenSim:
                     for w in range(cfg.llc.ways)
                 ):
                     continue  # LLC hit: no controller access
+                if self._pf_hit(c, line):
+                    continue  # prefetch-covered miss: no controller access
                 a = (
                     cyc
                     + pre * int(self.cpi[c])
                     + cfg.l1.latency
-                    + one_way_lat(core_tile(c, cfg), bank_tile(b, cfg), cfg)
+                    + self._owl(core_tile(c, cfg), bank_tile(b, cfg))
                     + cfg.llc.latency
                 )
                 self._dram_users.setdefault(b, []).append((cyc, c))
@@ -597,9 +664,16 @@ class GoldenSim:
                         lat += self._noc(c, btile, otile)
                         lat += self._noc(c, otile, btile)
                         self.counters["probes"][c] += 1
-                        phase_b.append((owner, line, "downgrade"))
-                        self.llc_owner[b, bs, w] = -1
-                        self._clear_sharers(b, bs, w)
+                        if cfg.coherence == "moesi":
+                            # dirty sharing: the probed owner KEEPS the
+                            # line (derives to O on its next access) and
+                            # existing sharers stay recorded — no
+                            # downgrade op, no owner clear
+                            pass
+                        else:
+                            phase_b.append((owner, line, "downgrade"))
+                            self.llc_owner[b, bs, w] = -1
+                            self._clear_sharers(b, bs, w)
                         self._set_sharer(b, bs, w, c, True)
                         # The directory cannot observe silent L1 evictions,
                         # so the probed owner is conservatively re-recorded
@@ -611,6 +685,11 @@ class GoldenSim:
                         self._set_sharer(b, bs, w, owner, True)
                         grant = S
                     elif shared_any:
+                        # no-op under mesi (owner >= 0 implies an empty
+                        # sharer vector there); under moesi the owner's
+                        # OWN refetch after a silent eviction lands here
+                        # and relinquishes ownership
+                        self.llc_owner[b, bs, w] = -1
                         self._set_sharer(b, bs, w, c, True)
                         grant = S
                     else:
@@ -632,15 +711,15 @@ class GoldenSim:
                     # go to the recorded cores minus the requester
                     for tcore in recorded:
                         ttile = core_tile(tcore, cfg)
-                        rt = one_way_lat(btile, ttile, cfg) * 2
+                        rt = self._owl(btile, ttile) * 2
                         if cfg.sharer_group > 1 or tcore != c:
                             inv_lat = max(inv_lat, rt)
                     for tcore in shl:
                         ttile = core_tile(tcore, cfg)
                         self.counters["invalidations"][c] += 1
                         self.counters["noc_msgs"][c] += 2
-                        self.counters["noc_hops"][c] += 2 * _hops(
-                            btile, ttile, cfg.noc.mesh_x
+                        self.counters["noc_hops"][c] += 2 * self._thops(
+                            btile, ttile
                         )
                         phase_b.append((tcore, line, "invalidate"))
                     lat += inv_lat
@@ -653,22 +732,30 @@ class GoldenSim:
                 self.counters["llc_misses"][c] += 1
                 self.counters["dram_accesses"][c] += 1
                 self.counters["noc_msgs"][c] += 2  # to co-located controller
-                if cfg.dram_queue:
-                    svc = cfg.dram_service or cfg.dram_lat
-                    bkey = (cyc, c)
-                    rank = sum(
-                        1 for k in self._dram_users.get(b, ()) if k < bkey
-                    )
-                    a = self._dram_arr[c]
-                    start = max(
-                        a,
-                        max(int(self.dram_free[b]), self._dram_base[b])
-                        + rank * svc,
-                    )
-                    self.counters["dram_queue_cycles"][c] += start - a
-                    lat += start - a
-                    self._dram_starts.append((b, start + svc))
-                lat += cfg.dram_lat
+                if self._pf_hit(c, line):
+                    # covered by the stride prefetcher: pay the buffer
+                    # latency, skip the controller queue AND dram_lat
+                    # (dram_accesses above still counts it — the fetch
+                    # happened, just earlier)
+                    self.counters["prefetch_hits"][c] += 1
+                    lat += cfg.prefetch_lat
+                else:
+                    if cfg.dram_queue:
+                        svc = cfg.dram_service or cfg.dram_lat
+                        bkey = (cyc, c)
+                        rank = sum(
+                            1 for k in self._dram_users.get(b, ()) if k < bkey
+                        )
+                        a = self._dram_arr[c]
+                        start = max(
+                            a,
+                            max(int(self.dram_free[b]), self._dram_base[b])
+                            + rank * svc,
+                        )
+                        self.counters["dram_queue_cycles"][c] += start - a
+                        lat += start - a
+                        self._dram_starts.append((b, start + svc))
+                    lat += cfg.dram_lat
                 # victim selection on step-start state
                 w = self._victim_way(
                     self.llc_tag[b, bs],
@@ -687,8 +774,8 @@ class GoldenSim:
                         ttile = core_tile(tcore, cfg)
                         self.counters["invalidations"][c] += 1
                         self.counters["noc_msgs"][c] += 2
-                        self.counters["noc_hops"][c] += 2 * _hops(
-                            btile, ttile, cfg.noc.mesh_x
+                        self.counters["noc_hops"][c] += 2 * self._thops(
+                            btile, ttile
                         )
                         phase_b.append((tcore, vline, "invalidate"))
                 self.llc_tag[b, bs, w] = line
@@ -709,8 +796,8 @@ class GoldenSim:
                 # replace the analytic request/reply legs with the hop-by
                 # -hop walk; everything between them (LLC, probes,
                 # invalidations, DRAM) is the service interval
-                req_a = one_way_lat(ctile, btile, cfg)
-                rep_a = one_way_lat(btile, ctile, cfg)
+                req_a = self._owl(ctile, btile)
+                rep_a = self._owl(btile, ctile)
                 service = lat - cfg.l1.latency - req_a - rep_a
                 t0 = cyc + pre * int(self.cpi[c]) + cfg.l1.latency
                 t_end = self._route_rt(c, t0, btile, service)
@@ -748,6 +835,7 @@ class GoldenSim:
             self.cycles[c] += pre * int(self.cpi[c]) + lat
             self.counters["instructions"][c] += pre + 1
             self.ptr[c] += 1
+            self._pf_train(c, line)
 
         # --- phase 4.B: remote ops, tag-conditional against live state -----
         for tcore, line, op in phase_b:
@@ -832,7 +920,7 @@ class GoldenSim:
                 t0 = int(self.cycles[c])
                 t_end = self._route(
                     t0,
-                    xy_links(ctile, h, cfg.noc.mesh_x),
+                    self._links(ctile, h),
                     self._rtr_key[c],
                 )
                 self.counters["noc_contention_cycles"][c] += (
@@ -922,8 +1010,8 @@ class GoldenSim:
         lat += self._noc(c, btile, ctile)
         lat += self._contention_extra(c, ctile, btile)
         if self._router_on:
-            req_a = one_way_lat(ctile, btile, cfg)
-            rep_a = one_way_lat(btile, ctile, cfg)
+            req_a = self._owl(ctile, btile)
+            rep_a = self._owl(btile, ctile)
             service = lat - cfg.l1.latency - req_a - rep_a  # llc.latency
             t0 = int(self.cycles[c]) + pre * int(self.cpi[c]) + cfg.l1.latency
             t_end = self._route_rt(c, t0, btile, service)
@@ -947,6 +1035,7 @@ class GoldenSim:
         self.cycles[c] += pre * int(self.cpi[c]) + lat
         self.counters["instructions"][c] += pre + 1
         self.ptr[c] += 1
+        self._pf_train(c, line)
 
     # ----------------------------------------------------- static helpers
 

@@ -51,7 +51,8 @@ def _reduce_kernel(
     shw_ref, vic_ref, btile_ref, vic_owner_ref, inv_row_ref, vic_valid_ref,
     self_ref, link_ref, router_ref,
     inv_lat_ref, inv_cnt_ref, inv_hops_ref, back_cnt_ref, back_hops_ref,
-    *, C: int, NW: int, n_tiles: int, mesh_x: int,
+    *, C: int, NW: int, n_tiles: int, mesh_x: int, mesh_y: int,
+    topology: str,
 ):
     BC = shw_ref.shape[0]
     t = jax.lax.broadcasted_iota(jnp.int32, (BC, NW * 32), 1)  # target ids
@@ -66,7 +67,12 @@ def _reduce_kernel(
     tt = t % n_tiles
     bx, by = bt % mesh_x, bt // mesh_x
     tx, ty = tt % mesh_x, tt // mesh_x
-    hops = jnp.abs(bx - tx) + jnp.abs(by - ty)
+    # topology is a STATIC kwarg (part of the jit/exec-cache key via
+    # timing_normalized); coord_hops is all elementwise min/abs/where
+    # arithmetic, so every topology stays Mosaic-safe
+    from ..noc.topology import coord_hops
+
+    hops = coord_hops(topology, bx, by, tx, ty, mesh_x, mesh_y, xp=jnp)
     lat2 = 2 * (hops * link_lat + (hops + 1) * router_lat)
     hops2 = 2 * hops
     selfid = self_ref[...]
@@ -118,6 +124,8 @@ def sharer_reductions(
         NW=NW,
         n_tiles=cfg.n_tiles,
         mesh_x=cfg.noc.mesh_x,
+        mesh_y=cfg.noc.mesh_y,
+        topology=cfg.noc.topology,
     )
     col = lambda i: (i, 0)
     scal = lambda i: (0, 0)

@@ -315,7 +315,7 @@ def probe_classify(
 
 def _commit_kernel(
     *refs, NC: int, S1: int, W1: int, W2: int, NW: int, MW: int, DW: int,
-    G: int, rl: int,
+    G: int, rl: int, moesi: bool,
 ):
     FS = W1 * S1
     n_in = 9 + (3 if rl else 0)
@@ -417,9 +417,16 @@ def _commit_kernel(
         iota_nw == (og >> 5), jnp.int32(1) << (og & 31), 0
     )
     new_owner = jnp.where(takes_own, cid, -1)
+    probe_word = self_word | owner_word
+    if moesi:
+        # dirty sharing (DESIGN.md §25): a GETS probe leaves the probed
+        # owner recorded (derived Owned) and accumulates sharers; shw is
+        # always 0 here under mesi, so mesi output is unchanged
+        new_owner = jnp.where(gets_probe, oclamp, new_owner)
+        probe_word = shw | probe_word
     new_shw = jnp.where(
         gets_probe,
-        self_word | owner_word,
+        probe_word,
         jnp.where(gets_shared, shw | self_word, 0),
     )
     join_word = self_word & ~shw
@@ -487,7 +494,7 @@ def commit_step(
     rl = 0 if hm is None else hm.shape[1]
     kern = functools.partial(
         _commit_kernel, NC=NC, S1=S1, W1=W1, W2=W2, NW=NW, MW=MW, DW=DW,
-        G=cfg.sharer_group, rl=rl,
+        G=cfg.sharer_group, rl=rl, moesi=cfg.coherence == "moesi",
     )
     col = lambda i: (i, 0)
     scal = lambda i: (0, 0)

@@ -1,0 +1,132 @@
+"""Multi-ring NoC topology plugin (DESIGN.md §25).
+
+One horizontal ring per row plus ONE vertical ring at column 0 — the
+hierarchical-ring shape (row rings bridged by a global spine). A message
+between rows takes three legs: shortest way around the source row's ring
+to column 0, shortest way around the spine to the destination row, then
+shortest way around the destination row's ring to the target column.
+Same-row traffic stays on its row ring.
+
+Link ids reuse the mesh numbering (tile*4 + dir, 0=E 1=W 2=N 3=S) so
+`n_links` and every contention/fault scatter shape is unchanged; the
+non-spine vertical links (columns > 0) simply never carry traffic. Same
+layered contract as `mesh`/`torus`: xp-generic `hops`, memoized scalar
+`route_links` reference walk, vectorized `path_links` matching it
+link-for-link.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.machine import MachineConfig
+from .torus import _ring_step, ring_dist
+
+
+def hops(tile_a, tile_b, mesh_x: int, mesh_y: int, xp=jnp):
+    ax, ay = tile_a % mesh_x, tile_a // mesh_x
+    bx, by = tile_b % mesh_x, tile_b // mesh_x
+    direct = ring_dist(xp, ax, bx, mesh_x)
+    via = (
+        ring_dist(xp, ax, 0 * ax, mesh_x)
+        + ring_dist(xp, ay, by, mesh_y)
+        + ring_dist(xp, 0 * bx, bx, mesh_x)
+    )
+    return xp.where(ay == by, direct, via)
+
+
+def path_width(mesh_x: int, mesh_y: int) -> int:
+    """Max route length: two half row-rings plus half the spine."""
+    return max(1, 2 * (mesh_x // 2) + mesh_y // 2)
+
+
+@functools.lru_cache(maxsize=None)
+def route_links(a: int, b: int, mesh_x: int, mesh_y: int) -> tuple[int, ...]:
+    """Directed link ids on the ring route tile a -> tile b (scalar,
+    memoized reference walk; the vectorized `path_links` must match
+    link-for-link)."""
+    ax, ay = a % mesh_x, a // mesh_x
+    bx, by = b % mesh_x, b // mesh_x
+    links = []
+
+    def row_leg(y: int, x0: int, x1: int) -> None:
+        s, n = _ring_step(x0, x1, mesh_x)
+        x = x0
+        for _ in range(n):
+            links.append((y * mesh_x + x) * 4 + (0 if s > 0 else 1))
+            x = (x + s) % mesh_x
+
+    if ay == by:
+        row_leg(ay, ax, bx)
+        return tuple(links)
+    row_leg(ay, ax, 0)
+    s, n = _ring_step(ay, by, mesh_y)
+    y = ay
+    for _ in range(n):
+        links.append((y * mesh_x + 0) * 4 + (2 if s > 0 else 3))
+        y = (y + s) % mesh_y
+    row_leg(by, 0, bx)
+    return tuple(links)
+
+
+def path_links(cfg: MachineConfig, a, b):
+    """Vectorized ring route a->b as directed link ids, -1-padded to the
+    ring diameter — three concatenated shorter-way legs (source row ring
+    to the spine, spine to the destination row, destination row ring),
+    collapsing to the direct row leg when the rows match."""
+    mx, my = cfg.noc.mesh_x, cfg.noc.mesh_y
+    H = path_width(mx, my)
+    ax, ay = a % mx, a // mx
+    bx, by = b % mx, b // mx
+    same = ay == by
+    i = jnp.arange(H, dtype=jnp.int32)[None, :]
+    # leg 1: row ay's ring, ax -> (bx when same row, else the spine at 0)
+    t1 = jnp.where(same, bx, 0)
+    d1p = (t1 - ax) % mx
+    d1n = (ax - t1) % mx
+    pos1 = d1p <= d1n
+    s1 = jnp.where(pos1, 1, -1)
+    n1 = jnp.minimum(d1p, d1n)
+    p1 = (ax[:, None] + s1[:, None] * i) % mx
+    l1 = (ay[:, None] * mx + p1) * 4 + jnp.where(pos1[:, None], 0, 1)
+    # leg 2: the column-0 spine ring, ay -> by (skipped when same row)
+    d2p = (by - ay) % my
+    d2n = (ay - by) % my
+    pos2 = d2p <= d2n
+    s2 = jnp.where(pos2, 1, -1)
+    n2 = jnp.where(same, 0, jnp.minimum(d2p, d2n))
+    j = i - n1[:, None]
+    p2 = (ay[:, None] + s2[:, None] * j) % my
+    l2 = (p2 * mx) * 4 + jnp.where(pos2[:, None], 2, 3)
+    # leg 3: row by's ring, 0 -> bx (skipped when same row)
+    d3p = bx % mx
+    d3n = (-bx) % mx
+    pos3 = d3p <= d3n
+    s3 = jnp.where(pos3, 1, -1)
+    n3 = jnp.where(same, 0, jnp.minimum(d3p, d3n))
+    k = j - n2[:, None]
+    p3 = (s3[:, None] * k) % mx
+    l3 = (by[:, None] * mx + p3) * 4 + jnp.where(pos3[:, None], 0, 1)
+    return jnp.where(
+        i < n1[:, None],
+        l1,
+        jnp.where(j < n2[:, None], l2, jnp.where(k < n3[:, None], l3, -1)),
+    )
+
+
+def detour_hops_table(cfg: MachineConfig) -> np.ndarray:
+    """Extra hops to detour around each FAILED directed link: a ring has
+    no orthogonal sidestep, so the fallback is the LONG way around the
+    same ring — (m - 1) hops replacing 1, i.e. m - 2 extra. Row-ring
+    links (dirs 0/1) detour around their row (mx - 2); spine links (dirs
+    2/3) around the spine (my - 2). Config validation requires
+    mesh_x >= 3 and mesh_y >= 3 for ring link faults, keeping every
+    entry positive."""
+    mx, my = cfg.noc.mesh_x, cfg.noc.mesh_y
+    tbl = np.empty((cfg.n_tiles, 4), np.int32)
+    tbl[:, 0:2] = mx - 2
+    tbl[:, 2:4] = my - 2
+    return tbl.reshape(-1)

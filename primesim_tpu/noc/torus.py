@@ -1,0 +1,107 @@
+"""2-D torus NoC topology plugin (DESIGN.md §25).
+
+The mesh with wrap-around edges: XY dimension-ordered routing, but each
+phase takes the SHORTER way around its ring (ties break toward the
+positive direction). Link ids reuse the mesh numbering — every tile still
+sources four directed links, id = tile*4 + dir with dir 0=E (+x), 1=W
+(-x), 2=N (+y), 3=S (-y) — so `n_links` and the contention models'
+scatter shapes are unchanged; only which links a route crosses differs.
+
+Same layered contract as `mesh`: `hops` works on NumPy and traced jnp
+arrays alike (the `xp` module parameter picks), `route_links` is the
+memoized scalar reference walk, `path_links` the vectorized builder the
+engine consumes, and the two must match link-for-link.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.machine import MachineConfig
+
+
+def ring_dist(xp, a, b, m: int):
+    """Shortest distance between positions a and b on a ring of m tiles."""
+    d = xp.abs(a - b)
+    return xp.minimum(d, m - d)
+
+
+def hops(tile_a, tile_b, mesh_x: int, mesh_y: int, xp=jnp):
+    ax, ay = tile_a % mesh_x, tile_a // mesh_x
+    bx, by = tile_b % mesh_x, tile_b // mesh_x
+    return ring_dist(xp, ax, bx, mesh_x) + ring_dist(xp, ay, by, mesh_y)
+
+
+def path_width(mesh_x: int, mesh_y: int) -> int:
+    """Max route length (torus diameter): half of each ring."""
+    return max(1, mesh_x // 2 + mesh_y // 2)
+
+
+def _ring_step(a: int, b: int, m: int) -> tuple[int, int]:
+    """Scalar (direction, count) of the shortest way a -> b around a ring
+    of m positions; ties break positive (matches `path_links`)."""
+    dpos = (b - a) % m
+    dneg = (a - b) % m
+    return (1, dpos) if dpos <= dneg else (-1, dneg)
+
+
+@functools.lru_cache(maxsize=None)
+def route_links(a: int, b: int, mesh_x: int, mesh_y: int) -> tuple[int, ...]:
+    """Directed link ids on the torus route tile a -> tile b (scalar,
+    memoized reference walk; the vectorized `path_links` must match
+    link-for-link)."""
+    ax, ay = a % mesh_x, a // mesh_x
+    bx, by = b % mesh_x, b // mesh_x
+    links = []
+    s, n = _ring_step(ax, bx, mesh_x)
+    x = ax
+    for _ in range(n):
+        links.append((ay * mesh_x + x) * 4 + (0 if s > 0 else 1))
+        x = (x + s) % mesh_x
+    s, n = _ring_step(ay, by, mesh_y)
+    y = ay
+    for _ in range(n):
+        links.append((y * mesh_x + bx) * 4 + (2 if s > 0 else 3))
+        y = (y + s) % mesh_y
+    return tuple(links)
+
+
+def path_links(cfg: MachineConfig, a, b):
+    """Vectorized torus route a->b as directed link ids, -1-padded to the
+    torus diameter — link-for-link identical to `route_links` (shorter-way
+    x phase at the source row, then shorter-way y phase at the destination
+    column)."""
+    mx, my = cfg.noc.mesh_x, cfg.noc.mesh_y
+    H = path_width(mx, my)
+    ax, ay = a % mx, a // mx
+    bx, by = b % mx, b // mx
+    i = jnp.arange(H, dtype=jnp.int32)[None, :]
+    dxp = (bx - ax) % mx
+    dxn = (ax - bx) % mx
+    posx = dxp <= dxn
+    sx = jnp.where(posx, 1, -1)
+    nx = jnp.minimum(dxp, dxn)
+    px = (ax[:, None] + sx[:, None] * i) % mx
+    xlink = (ay[:, None] * mx + px) * 4 + jnp.where(posx[:, None], 0, 1)
+    dyp = (by - ay) % my
+    dyn = (ay - by) % my
+    posy = dyp <= dyn
+    sy = jnp.where(posy, 1, -1)
+    ny = jnp.minimum(dyp, dyn)
+    j = i - nx[:, None]
+    py = (ay[:, None] + sy[:, None] * j) % my
+    ylink = (py * mx + bx[:, None]) * 4 + jnp.where(posy[:, None], 2, 3)
+    return jnp.where(
+        i < nx[:, None], xlink, jnp.where(j < ny[:, None], ylink, -1)
+    )
+
+
+def detour_hops_table(cfg: MachineConfig) -> np.ndarray:
+    """Extra hops a route pays to detour around each FAILED directed link
+    (faults/inject.py). A torus edge has the same minimal fallback as a
+    mesh edge — one orthogonal sidestep and return, +2 hops — so the
+    table is uniform (link faults require >= 2x2, as on the mesh)."""
+    return np.full(cfg.n_tiles * 4, 2, np.int32)

@@ -114,6 +114,10 @@ def state_pspecs() -> MachineState:
         sync_flag=P(AXIS),
         quantum_end=P(),
         step=P(),
+        # per-core stride-prefetcher tracking state shards with its cores
+        pf_line=P(AXIS),
+        pf_stride=P(AXIS),
+        pf_streak=P(AXIS),
         counters=P(None, AXIS),
         # traced timing knobs: the per-core cpi vector shards with the
         # cores it feeds; the scalars replicate
@@ -127,6 +131,8 @@ def state_pspecs() -> MachineState:
             dram_lat=P(),
             dram_service=P(),
             contention_lat=P(),
+            prefetch_degree=P(),
+            prefetch_lat=P(),
         ),
         # fault state: the per-core dead mask shards with the cores it
         # gates; link masks and the (tiny) schedule arrays replicate like

@@ -37,7 +37,7 @@ from ..faults.schedule import FaultState
 from ..stats.counters import COUNTER_NAMES
 from .state import MachineState, TimingKnobs
 
-_FORMAT = 6  # v3: fused dirm row (metadata + sharers) replaces
+_FORMAT = 7  # v3: fused dirm row (metadata + sharers) replaces
 # llc_meta/sharers; 5-plane l1; link_free/dram_free queue clocks.
 # v4: nested TimingKnobs state field (flattened to state_knobs__<name>
 # keys — npz holds flat arrays only).
@@ -47,6 +47,11 @@ _FORMAT = 6  # v3: fused dirm row (metadata + sharers) replaces
 # v6: prefix-fork provenance (prefix_steps + warm-cache key) on solo,
 # fleet, and element snapshots — --resume of a forked run is
 # self-describing, and the warm-state cache (below) shares the format.
+# v7: machine-zoo state — per-core stride-prefetcher tracking arrays
+# (pf_line/pf_stride/pf_streak) + two TimingKnobs fields
+# (prefetch_degree/prefetch_lat); older snapshots lack the arrays, so
+# the format bump keeps them from resuming with silently-zeroed
+# prefetcher state.
 
 # nested-NamedTuple state fields and their types (flattened by
 # _state_arrays to `state_<field>__<sub>` keys; extend here when a new

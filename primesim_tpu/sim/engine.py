@@ -53,6 +53,7 @@ from .state import (
     I,
     M,
     MachineState,
+    O,
     S,
     dirm_width,
     init_state,
@@ -88,7 +89,10 @@ def _group_tables(cfg: MachineConfig):
     for lo in range(0, nt, step):
         t = np.arange(lo, min(lo + step, nt))
         tx, ty = (t % mx)[:, None, None], (t // mx)[:, None, None]
-        h = np.abs(tx - gx[None]) + np.abs(ty - gy[None])  # [T, n_grp, G]
+        h = _topo.coord_hops(  # [T, n_grp, G]
+            cfg.noc.topology, tx, ty, gx[None], gy[None],
+            mx, cfg.noc.mesh_y, xp=np,
+        )
         max2hops[t] = np.where(valid[None], h, 0).max(2).astype(np.int32)
         sum2hops[t] = (
             np.where(valid[None], 2 * h, 0).sum(2).astype(np.int32)
@@ -99,20 +103,21 @@ def _group_tables(cfg: MachineConfig):
 
 
 def _one_way(tile_a, tile_b, cfg: MachineConfig, kn):
-    """Vectorized mesh latency + hop count (noc/mesh.py semantics).
-    Latencies come from the traced knobs; cfg supplies geometry."""
-    mx = cfg.noc.mesh_x
-    ax, ay = tile_a % mx, tile_a // mx
-    bx, by = tile_b % mx, tile_b // mx
-    h = jnp.abs(ax - bx) + jnp.abs(ay - by)
+    """Vectorized one-way latency + hop count under cfg's topology
+    (noc/topology.py semantics). Latencies come from the traced knobs;
+    cfg supplies geometry — the topology selector is STATIC, so each
+    topology compiles its own hop formula."""
+    h = _topo.hops(cfg, tile_a, tile_b, xp=jnp)
     return h * kn.link_lat + (h + 1) * kn.router_lat, h
 
 
-# vectorized XY route builder (link id = tile*4 + dir, dir 0=E 1=W 2=N
-# 3=S), shared with the fault-injection detour model — lives in noc.mesh
-# next to its scalar reference `xy_links`
+# vectorized route builder (link id = tile*4 + dir, dir 0=E 1=W 2=N 3=S,
+# identical numbering for every topology), shared with the fault-injection
+# detour model — dispatched on the static `noc_topology` selector by
+# noc.topology next to each plugin's scalar reference walk
+from ..noc import topology as _topo  # noqa: E402
 from ..noc.mesh import concat_legs as _concat_legs  # noqa: E402
-from ..noc.mesh import path_links as _path_links  # noqa: E402
+from ..noc.topology import path_links as _path_links  # noqa: E402
 
 # sort-based segmented FIFO ranking (DESIGN.md §13) — the shared rank
 # primitive of the router and DRAM-queue contention models; replaces the
@@ -454,6 +459,24 @@ def step(
                 jnp.where(pbit, S, I),
             ),
         )  # [C, rl+1] effective MESI of the tag-matching way
+        if cfg.coherence == "moesi":
+            # derived Owned (DESIGN.md §25): this core owns the line at
+            # the home while other sharers are recorded — a run's ST on
+            # it must arbitrate (the sharers need invalidating), so the
+            # probe's effective E/M demotes to O. sharer_group == 1 under
+            # moesi (config validation), so pbit IS the self bit and the
+            # word popcount is an exact sharer count.
+            psh_all = pmrows[:, :, MW:].reshape(C, rl + 1, W2, NW)
+            pwords = jnp.take_along_axis(
+                psh_all, pmway[:, :, None, None], axis=2
+            )[:, :, 0]  # [C, rl+1, NW]
+            ptot = jnp.sum(jax.lax.population_count(pwords), axis=2)
+            pothers = (ptot - pbit.astype(jnp.int32)) > 0
+            peff = jnp.where(
+                pothers & pmhas & (pown == arange_c[:, None]) & (peff >= E),
+                O,
+                peff,
+            )
         phitcol = plway * S1 + ps
     if rl:
         # CLOSED FORM for the run itself (no unrolled loop): a candidate
@@ -475,7 +498,11 @@ def step(
         eprer = pev[:, :rl, 3]
         is_ins_k = etr == EV_INS
         r_hit_k = (etr == EV_LD) & (peff[:, :rl] != I)
-        w_hit_k = (etr == EV_ST) & (peff[:, :rl] >= E)
+        # E/M exactly — a derived O (moesi) reads locally but must
+        # arbitrate its stores (same pair under mesi, where peff <= M)
+        w_hit_k = (etr == EV_ST) & (
+            (peff[:, :rl] == E) | (peff[:, :rl] == M)
+        )
         hit_k = r_hit_k | w_hit_k
         local_k = is_ins_k | hit_k  # END/sync/miss candidates stop the run
         pref = jnp.cumprod(local_k.astype(jnp.int32), axis=1) != 0
@@ -593,11 +620,8 @@ def step(
     is_unlock = active & (et == EV_UNLOCK)
     is_barrier = active & (et == EV_BARRIER)  # arrivals (frozen excluded)
 
-    read_hit = is_mem & ~is_st_ev & hit_any
-    write_hit = is_mem & is_st_ev & hit_any & (hit_state >= E)
-    upg = is_mem & is_st_ev & hit_any & (hit_state == S)
-    gets = is_mem & ~is_st_ev & ~hit_any
-    getm = is_mem & is_st_ev & ~hit_any
+    # (hit classification moved below the LLC parse: the moesi derived-O
+    # demotion needs the home row's owner + sharer predicates first)
 
     # LLC lookup for the accessed line (step-start, all lanes — needed both
     # for join eligibility below and the winner transitions in phase 3).
@@ -665,6 +689,32 @@ def step(
             other_sharers = total_sharers > 0
         else:
             other_sharers = (total_sharers - self_bit) > 0
+
+    if cfg.coherence == "moesi":
+        # derived Owned (DESIGN.md §25): a stored E/M hit while the home
+        # directory still names this core owner WITH other sharers
+        # recorded (a GETS left the dirty copy here) is an O hit — reads
+        # stay local, but a store must arbitrate as an upgrade to
+        # invalidate the sharers. Pure demotion of the classification
+        # input; the stored plane is untouched (O is never written).
+        hit_state = jnp.where(
+            hit_any & llc_has & (owner == arange_c) & other_sharers
+            & (hit_state >= E),
+            O,
+            hit_state,
+        )
+
+    read_hit = is_mem & ~is_st_ev & hit_any
+    # E/M exactly, never a derived O (the `(== E) | (== M)` pair is
+    # `>= E` under mesi, where hit_state <= M)
+    write_hit = is_mem & is_st_ev & hit_any & (
+        (hit_state == E) | (hit_state == M)
+    )
+    upg = is_mem & is_st_ev & hit_any & (
+        (hit_state == S) | (hit_state == O)
+    )
+    gets = is_mem & ~is_st_ev & ~hit_any
+    getm = is_mem & is_st_ev & ~hit_any
 
     # ---- phase 2: read-join coalescing + per-(bank,set) arbitration ------
     # GETS to an LLC-resident, ownerless, already-shared line may coalesce:
@@ -1000,6 +1050,46 @@ def step(
         back_count = jnp.sum(back_pairs, axis=1).astype(jnp.int32)
         back_hops = jnp.sum(jnp.where(back_pairs, 2 * pair_hops, 0), axis=1).astype(jnp.int32)
 
+    # --- stride prefetcher (DESIGN.md §25; cfg.prefetcher static) ---------
+    # Per-core stride detector over the UNCORE access stream (winners +
+    # joins — the retired home transactions; retries re-observe the same
+    # line next step and must not retrain). An LLC miss whose line sits
+    # within prefetch_degree strides ahead of the last trained access on
+    # a confirmed stride (streak >= 2) is served from the prefetch buffer:
+    # it pays the TRACED prefetch_lat instead of dram_lat and skips the
+    # memory-controller queue. dram_accesses still counts every LLC miss
+    # (the prefetcher moved the fetch earlier, it did not remove it);
+    # prefetch_hits counts the covered ones. State is step-entry: at most
+    # one retiring uncore event per core per step, and joins train only
+    # their own core, so read-then-train is race-free.
+    if cfg.prefetcher == "stride":
+        pfl, pfs, pfk = st.pf_line, st.pf_stride, st.pf_streak
+        safe_s = jnp.where(pfs == 0, 1, pfs)
+        delta = line - pfl
+        qd = delta // safe_s
+        rem = delta - qd * safe_s
+        pf_hit = (
+            llc_miss & (pfs != 0) & (pfk >= 2) & (rem == 0)
+            & (qd >= 1) & (qd <= kn.prefetch_degree)
+        )
+        miss_dram = llc_miss & ~pf_hit  # misses that still go to DRAM
+        cnt = cadd(cnt, "prefetch_hits", pf_hit)
+        pf_train = winner | join
+        new_stride = line - pfl
+        pf_streak_n = jnp.where(
+            pf_train,
+            jnp.where((new_stride == pfs) & (pfs != 0), pfk + 1, 1),
+            pfk,
+        )
+        pf_stride_n = jnp.where(pf_train, new_stride, pfs)
+        pf_line_n = jnp.where(pf_train, line, pfl)
+    else:
+        pf_hit = jnp.zeros(C, bool)
+        miss_dram = llc_miss
+        pf_line_n = st.pf_line
+        pf_stride_n = st.pf_stride
+        pf_streak_n = st.pf_streak
+
     # --- memory-controller queue (cfg.dram_queue, SURVEY §2 #7) -----------
     # Miss winners queue at their home bank's controller: wait floor =
     # max(dram_free[bank], bank's earliest nominal arrival this step) +
@@ -1015,7 +1105,7 @@ def step(
             cycles_c + epre * cpi_vec + l1_lat + req_lat
             + llc_lat
         )
-        dtgt = jnp.where(llc_miss, bank, B)
+        dtgt = jnp.where(miss_dram, bank, B)
         dbase = jnp.full(B, INT32_MAX, jnp.int32).at[dtgt].min(
             a_nom, mode="drop"
         )
@@ -1027,7 +1117,7 @@ def step(
             a_nom,
             jnp.maximum(st.dram_free[bank], dbase[bank]) + rd * svc_d,
         )
-        extra_dram = jnp.where(llc_miss, dstart - a_nom, 0)
+        extra_dram = jnp.where(miss_dram, dstart - a_nom, 0)
         dram_free_n = st.dram_free.at[dtgt].max(dstart + svc_d, mode="drop")
         cnt = cadd(cnt, "dram_queue_cycles", extra_dram)
     else:
@@ -1040,12 +1130,16 @@ def step(
     # the reply's injection: LLC lookup + probe legs + invalidation waits
     # + controller queueing + DRAM (memory lanes), plain LLC lookup
     # (joins, lock/unlock RMWs)
+    dram_term = jnp.where(miss_dram, kn.dram_lat, 0)
+    if cfg.prefetcher != "none":
+        # prefetch-covered misses pay the (traced) buffer latency instead
+        dram_term = dram_term + jnp.where(pf_hit, kn.prefetch_lat, 0)
     service = jnp.where(
         winner,
         llc_lat
         + jnp.where(probe_any, 2 * po_lat, 0)
         + jnp.where(write_w & llc_hit, inv_lat, 0)
-        + jnp.where(llc_miss, kn.dram_lat, 0)
+        + dram_term
         + extra_dram,
         llc_lat,
     )
@@ -1439,6 +1533,11 @@ def step(
         # refreshes land in a second element scatter (same-slot joiners write
         # the identical step stamp).
         new_owner = jnp.where(takes_own, arange_c, -1)
+        if cfg.coherence == "moesi":
+            # dirty sharing: a GETS probe LEAVES the probed owner recorded
+            # (its line derives to Owned — DESIGN.md §25) instead of
+            # clearing it; every other non-owning transition still clears.
+            new_owner = jnp.where(gets_probe, oclamp, new_owner)
         wayeq = jnp.arange(W2, dtype=jnp.int32)[None, :] == llc_uway[:, None]
         new_meta = jnp.concatenate(
             [
@@ -1471,9 +1570,15 @@ def step(
             jnp.int32(1) << (og_bit % 32)[:, None],
             0,
         )
+        probe_word = self_word | owner_word
+        if cfg.coherence == "moesi":
+            # dirty sharing accumulates: existing sharers stay recorded
+            # alongside requester + owner (shw == 0 here under mesi — any
+            # owner-setting transition cleared it)
+            probe_word = shw | probe_word
         new_shw = jnp.where(
             gets_probe[:, None],
-            self_word | owner_word,
+            probe_word,
             jnp.where(
                 gets_shared[:, None],
                 shw | self_word,
@@ -1732,6 +1837,9 @@ def step(
         sync_flag=sync_flag,
         quantum_end=quantum_end,
         step=step_no + 1,
+        pf_line=pf_line_n,
+        pf_stride=pf_stride_n,
+        pf_streak=pf_streak_n,
         counters=counters_final,
         knobs=kn,
         # post-injection fault state (phase -1 rebound `st`); faults-off

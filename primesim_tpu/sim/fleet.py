@@ -85,6 +85,8 @@ KNOB_KEYS = (
     "dram_lat",
     "dram_service",
     "contention_lat",
+    "prefetch_degree",
+    "prefetch_lat",
     "fault_seed",
 )
 
@@ -138,6 +140,12 @@ def apply_overrides(cfg: MachineConfig, ov: dict | None) -> MachineConfig:
         out = dataclasses.replace(out, dram_lat=int(ov["dram_lat"]))
     if "dram_service" in ov:
         out = dataclasses.replace(out, dram_service=int(ov["dram_service"]))
+    if "prefetch_degree" in ov:
+        out = dataclasses.replace(
+            out, prefetch_degree=int(ov["prefetch_degree"])
+        )
+    if "prefetch_lat" in ov:
+        out = dataclasses.replace(out, prefetch_lat=int(ov["prefetch_lat"]))
     if "fault_seed" in ov:
         out = dataclasses.replace(out, fault_seed=int(ov["fault_seed"]))
     if out.quantum * out.n_cores >= 2**31:

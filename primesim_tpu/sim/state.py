@@ -21,6 +21,14 @@ from ..stats.counters import COUNTER_NAMES
 
 # MESI encoding (shared with primesim_tpu.golden.sim)
 I, S, E, M = 0, 1, 2, 3
+# MOESI's Owned state (cfg.coherence == "moesi", DESIGN.md §25). DERIVED,
+# never stored: the L1 plane still holds only I/S/E/M, and an access sees
+# O when the directory says this core owns the line while other sharers
+# are recorded (a GETS left the dirty copy in place). Keeping O out of
+# the stored encoding keeps every plane layout and Pallas kernel
+# unchanged; O > M so `>= E`-style "exclusive" tests must be written as
+# the explicit (== E) | (== M) pair wherever a derived state can appear.
+O = 4
 
 
 def llc_meta_width(cfg: MachineConfig) -> int:
@@ -60,6 +68,8 @@ class TimingKnobs(NamedTuple):
     dram_lat: jnp.ndarray  # [] — DRAM access latency
     dram_service: jnp.ndarray  # [] — controller occupancy (0 -> dram_lat)
     contention_lat: jnp.ndarray  # [] — queueing cycles per transaction
+    prefetch_degree: jnp.ndarray  # [] — stride-prefetch lookahead, lines
+    prefetch_lat: jnp.ndarray  # [] — LLC-miss cost on a prefetch hit
 
 
 def knobs_from_config(cfg: MachineConfig) -> TimingKnobs:
@@ -79,6 +89,8 @@ def knobs_from_config(cfg: MachineConfig) -> TimingKnobs:
         dram_lat=i32(cfg.dram_lat),
         dram_service=i32(cfg.dram_service),
         contention_lat=i32(cfg.noc.contention_lat),
+        prefetch_degree=i32(cfg.prefetch_degree),
+        prefetch_lat=i32(cfg.prefetch_lat),
     )
 
 
@@ -144,6 +156,15 @@ class MachineState(NamedTuple):
     # global clocks
     quantum_end: jnp.ndarray  # [] int32
     step: jnp.ndarray  # [] int32
+    # stride-prefetcher training state (cfg.prefetcher == "stride",
+    # DESIGN.md §25): last trained line address, last stride (lines) and
+    # the consecutive same-stride streak, per core. Always present so the
+    # pytree structure is config-stable (like `faults`); with the
+    # selector off (static) step() never reads them and carries the
+    # zeros through untouched
+    pf_line: jnp.ndarray  # [C] int32
+    pf_stride: jnp.ndarray  # [C] int32
+    pf_streak: jnp.ndarray  # [C] int32
     # stat counters, one row per COUNTER_NAMES entry
     counters: jnp.ndarray  # [n_counters, C] int32
     # traced per-simulation timing knobs (see TimingKnobs): constant
@@ -195,6 +216,9 @@ def init_state(cfg: MachineConfig) -> MachineState:
         barrier_count=jnp.zeros(cfg.barrier_slots, jnp.int32),
         barrier_time=jnp.zeros(cfg.barrier_slots, jnp.int32),
         sync_flag=jnp.zeros(C, jnp.int32),
+        pf_line=jnp.zeros(C, jnp.int32),
+        pf_stride=jnp.zeros(C, jnp.int32),
+        pf_streak=jnp.zeros(C, jnp.int32),
         quantum_end=jnp.asarray(cfg.quantum, jnp.int32),
         step=jnp.asarray(0, jnp.int32),
         counters=jnp.zeros((len(COUNTER_NAMES), C), jnp.int32),

@@ -40,6 +40,8 @@ COUNTER_NAMES = (
     "ecc_corrected",   # single-bit flips corrected in-line by SECDED
     "ecc_due",         # detected-uncorrectable (double-bit) errors
     "core_failstops",  # cores fail-stopped (scheduled or DUE-escalated)
+    # ---- machine zoo (DESIGN.md §25; zero with prefetcher "none") ------
+    "prefetch_hits",   # LLC misses served by the stride prefetcher
 )
 
 

@@ -14,18 +14,34 @@ CONFIGS = sorted(glob.glob(os.path.join(REPO, "configs", "*.json")))
 
 
 def test_ladder_configs_ship_and_validate():
-    assert len(CONFIGS) == 5, CONFIGS  # the five BASELINE rungs
-    names = [os.path.basename(p) for p in CONFIGS]
+    ladder = [p for p in CONFIGS if os.path.basename(p).startswith("rung")]
+    assert len(ladder) == 5, ladder  # the five BASELINE rungs
+    names = [os.path.basename(p) for p in ladder]
     for n, cores in zip(
         sorted(names), [64, 256, 1024, 4096, 16384]
     ):
         assert str(cores) in n, (n, cores)
-    for p in CONFIGS:
+    for p in ladder:
         with open(p) as f:
             cfg = MachineConfig.from_json(f.read())  # __post_init__ validates
         assert cfg.n_cores in (64, 256, 1024, 4096, 16384)
         # round trip through to_json preserves the machine
         assert MachineConfig.from_json(cfg.to_json()) == cfg
+
+
+def test_zoo_and_calib_configs_ship_and_validate():
+    zoo = [p for p in CONFIGS if os.path.basename(p).startswith("zoo_")]
+    assert len(zoo) == 2, zoo
+    for p in zoo:
+        with open(p) as f:
+            cfg = MachineConfig.from_json(f.read())
+        assert cfg.noc.topology in ("mesh", "torus", "ring")
+        assert MachineConfig.from_json(cfg.to_json()) == cfg
+    from primesim_tpu.calib.table import parse_table
+
+    with open(os.path.join(REPO, "configs", "calib_ipu_microbench.json")) as f:
+        table = parse_table(f.read())
+    assert table.entries and all(e.metric for e in table.entries)
 
 
 def test_biglittle_pattern_tiles():
