@@ -562,6 +562,69 @@ def main() -> None:
             "passed": bool(cres.cost <= 1e-6),
         }
 
+    # degraded-mode recovery economics (DESIGN.md §26): a supervised
+    # sharded run that loses a device at a chunk boundary finishes
+    # bit-exact after the reshard rung; this prices the recovery —
+    # snapshot reload + re-placement onto the smaller mesh + recompile —
+    # against the identical run with no loss. Advisory only (the cost
+    # is dominated by XLA recompile wall, which varies wildly across
+    # hosts); null when PRIMETPU_BENCH_DEGRADE=0 or < 2 visible devices.
+    degrade_detail = None
+    if os.environ.get("PRIMETPU_BENCH_DEGRADE", "1") != "0":
+        import tempfile
+
+        import jax
+
+        from primesim_tpu.chaos import plan as CP
+        from primesim_tpu.chaos import sites as CS
+        from primesim_tpu.config.machine import small_test_config
+        from primesim_tpu.parallel import sharding
+        from primesim_tpu.sim.engine import Engine
+        from primesim_tpu.sim.supervisor import RunSupervisor
+
+        if len(jax.devices()) >= 2:
+            dcfg = small_test_config(8, n_banks=8)
+            dtrace = synth.fft_like(
+                8, n_phases=1, points_per_core=32, seed=9
+            )
+            dn = sharding.largest_valid_submesh(dcfg, len(jax.devices()))
+
+            def _degrade_run(with_loss: bool):
+                sharding.restore_devices()
+                snap = tempfile.mkdtemp(prefix="primetpu-bench-degrade-")
+                mesh = sharding.tile_mesh(devices=jax.devices()[:dn])
+                eng = Engine(dcfg, dtrace, chunk_steps=64, mesh=mesh)
+                sup = RunSupervisor(
+                    eng, snapshot_dir=snap, checkpoint_every_chunks=1,
+                    handle_signals=False,
+                )
+                if with_loss:
+                    CS.install(CP.FaultPlan(seed=0, events=(
+                        CP.FaultEvent(
+                            site="devices.revoke", occurrence=2,
+                            action="revoke", args=(("n", 1),),
+                        ),
+                    )))
+                t0 = time.perf_counter()
+                try:
+                    sup.run()
+                finally:
+                    CS.deactivate()
+                    sharding.restore_devices()
+                return time.perf_counter() - t0, list(sup.degrade_rungs)
+
+            degrade_wall_clean, _ = _degrade_run(False)
+            degrade_wall_loss, degrade_rungs = _degrade_run(True)
+            degrade_detail = {
+                "devices": int(dn),
+                "wall_s_clean": round(degrade_wall_clean, 3),
+                "wall_s_with_device_loss": round(degrade_wall_loss, 3),
+                "degrade_recovery_wall_s": round(
+                    degrade_wall_loss - degrade_wall_clean, 3
+                ),
+                "rungs": degrade_rungs,
+            }
+
     # LIVE per-phase cuts (scripts/prof/prof_phase.py source surgery) on
     # elastic pool scaling (DESIGN.md §17): the same 16-element campaign
     # through `sweep --workers 1` vs `--workers 3` — real worker
@@ -932,6 +995,10 @@ def main() -> None:
                     # PRIMETPU_BENCH_COLDSTART=0)
                     "cold_start": cold_detail,
                     "cold_start_gate": cold_gate,
+                    # device-loss recovery cost on a sharded supervised
+                    # run (DESIGN.md §26); advisory, null when
+                    # PRIMETPU_BENCH_DEGRADE=0 or < 2 visible devices
+                    "degrade_recovery": degrade_detail,
                     # STATIC RECORD: round-5 restructure evidence measured
                     # on TPU 2026-07-30 (scripts/prof/prof_phase.py
                     # cumulative cuts / prof_bisect.py ablations,

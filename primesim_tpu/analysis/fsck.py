@@ -844,6 +844,15 @@ def run_fsck(root: str, repair: str = "none") -> FsckResult:
                     "debris; safe to quarantine)", corrupt=False,
                     repairable=True,
                 ))
+            elif (name.endswith((".npz", ".bin", ".json"))
+                    and os.path.getsize(path) == 0):
+                checked["orphans"] += 1
+                findings.append(Finding(
+                    "orphan", rel,
+                    "zero-length artifact (ENOSPC-starved or "
+                    "interrupted write; safe to quarantine)",
+                    corrupt=False, repairable=True,
+                ))
             elif name.endswith(".npz"):
                 checked["checkpoints"] += 1
                 nf = _check_npz(path, rel)

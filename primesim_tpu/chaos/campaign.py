@@ -72,6 +72,16 @@ REPLICATION_SITES = ("replicate.send", "replica.pre-fsync-ack")
 #: as a bogus invariant-B violation there.
 ATTEST_SITES = ("fleet.counters", "checkpoint.payload")
 
+#: Degraded-mode capacity sites (DESIGN.md §26). Opt-in via `--classes
+#: capacity_loss` and routed to their OWN trial: seeded device
+#: revocation needs a supervised SHARDED engine (the serve trial's
+#: fleets have no mesh to lose), and sustained-ENOSPC windows need a
+#: harness that plays a backpressured client — retrying on
+#: `DiskPressureError` — rather than reading the typed rejection as a
+#: crash. The trial machine-checks INVARIANT G: no ACKed job lost and
+#: no bit-exactness violation under capacity loss.
+CAPACITY_SITES = ("devices.revoke", "disk.preflight")
+
 #: Small deterministic workloads (serve's synth grammar). Distinct seeds
 #: give distinct results, so a cross-wired job table fails invariant B.
 DEFAULT_SPECS = (
@@ -877,6 +887,195 @@ def run_attest_trial(
                        injected=injected)
 
 
+# ---- the capacity-loss trial (invariant G, DESIGN.md §26) ----------------
+
+# memoized fault-free unsharded reference for the supervisor half —
+# one per process, the sharded runs under revocation must match it
+_CAP_REF: dict = {}
+
+
+def _capacity_workload():
+    from ..config.machine import small_test_config
+    from ..trace import synth
+
+    cfg = small_test_config(8, n_banks=8)
+    trace = synth.fft_like(8, n_phases=1, points_per_core=12, seed=7)
+    return cfg, trace
+
+
+def _capacity_reference() -> dict:
+    """Unsharded, fault-free supervised run of the capacity workload:
+    the bit-exact target every degraded run is held to."""
+    import numpy as np
+
+    if _CAP_REF:
+        return _CAP_REF
+    from ..sim.engine import Engine
+    from ..sim.supervisor import RunSupervisor
+
+    assert sites.runtime() is None, "capacity reference must be fault-free"
+    cfg, trace = _capacity_workload()
+    eng = Engine(cfg, trace, chunk_steps=32)
+    RunSupervisor(eng, handle_signals=False).run()
+    _CAP_REF["cycles"] = np.asarray(eng.cycles).copy()
+    _CAP_REF["counters"] = {
+        k: np.asarray(v).copy() for k, v in eng.counters.items()
+    }
+    return _CAP_REF
+
+
+def _capacity_supervisor_half(tmp: str, violations: list) -> dict:
+    """Run the capacity workload SHARDED under the installed plan's
+    `devices.revoke` events and hold the recovered run to the fault-free
+    reference (invariant G, bit-exact half). On a single-device backend
+    the revocation clamps to a no-op and the run must simply complete."""
+    import jax
+    import numpy as np
+
+    from ..parallel import sharding
+    from ..sim.engine import Engine
+    from ..sim.supervisor import RunSupervisor
+
+    ref = _CAP_REF  # populated by run_capacity_trial before install
+    cfg, trace = _capacity_workload()
+    sharding.restore_devices()
+    mesh = None
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        n = sharding.largest_valid_submesh(cfg, n_dev)
+        if n > 1:
+            mesh = sharding.tile_mesh(devices=jax.devices()[:n])
+    eng = Engine(cfg, trace, chunk_steps=32, mesh=mesh)
+    sup = RunSupervisor(
+        eng, snapshot_dir=os.path.join(tmp, "snaps"),
+        checkpoint_every_chunks=1, handle_signals=False,
+    )
+    try:
+        sup.run()
+    except BaseException as e:  # noqa: BLE001 — any escape is a violation
+        violations.append(
+            f"invariant G: supervised run died under device loss: {e!r}"
+        )
+        return {"degrade_rungs": list(sup.degrade_rungs)}
+    finally:
+        sharding.restore_devices()
+    if not np.array_equal(np.asarray(eng.cycles), ref["cycles"]):
+        violations.append(
+            "invariant G: cycles diverged after device-loss recovery "
+            f"(rungs: {sup.degrade_rungs})"
+        )
+    for k, v in eng.counters.items():
+        if not np.array_equal(np.asarray(v), ref["counters"][k]):
+            violations.append(
+                f"invariant G: counter {k} diverged after device-loss "
+                f"recovery (rungs: {sup.degrade_rungs})"
+            )
+            break
+    return {"degrade_rungs": list(sup.degrade_rungs)}
+
+
+def run_capacity_trial(
+    plan: P.FaultPlan,
+    cfg=None,
+    specs=DEFAULT_SPECS,
+    golden: dict | None = None,
+    workdir: str | None = None,
+    keep_dir: bool = False,
+    buckets=((2, 1),),
+    chunk_steps: int = 16,
+) -> TrialResult:
+    """One seeded capacity-loss trial. Two halves under ONE runtime:
+
+    - `devices.revoke` events fire at supervised chunk boundaries of a
+      sharded run; the reshard -> unshard ladder must keep the result
+      bit-exact with the fault-free unsharded reference;
+    - `disk.preflight` events open sustained ENOSPC windows under the
+      in-process serve stack; the harness retries on `DiskPressureError`
+      the way a backpressured client would, and every ACKed job must
+      still reach its golden terminal state over a clean journal (fsck).
+    """
+    from ..analysis.fsck import run_fsck
+    from ..util.diskpressure import DiskPressureError
+
+    cfg = cfg or _default_cfg()
+    revoke_events = [e for e in plan.events if e.site == "devices.revoke"]
+    disk_events = [e for e in plan.events if e.site == "disk.preflight"]
+    if revoke_events:
+        _capacity_reference()
+    if disk_events and golden is None:
+        golden = golden_run(cfg, specs, buckets=buckets,
+                            chunk_steps=chunk_steps, workdir=workdir)
+    tmp = tempfile.mkdtemp(prefix="chaos-capacity-", dir=workdir)
+    violations: list = []
+    acked: dict = {}
+    idems = {i: f"chaos-{plan.seed}-{i}" for i in range(len(specs))}
+    restarts = 0
+    backpressured = 0
+    results: dict = {}
+    # a sustained window consumes one probe per free-space recheck, so
+    # bound the retry loop by the total window budget, not event count
+    window_budget = sum(
+        max(1, int(e.arg("calls", 3))) for e in disk_events
+    )
+    rt = sites.install(plan, mode="raise")
+    try:
+        if revoke_events:
+            _capacity_supervisor_half(tmp, violations)
+        if disk_events:
+            while True:
+                try:
+                    results = _run_to_completion(
+                        tmp, cfg, specs, idems, acked, violations,
+                        buckets, chunk_steps,
+                    )
+                    break
+                except DiskPressureError:
+                    # the typed backpressure a live client would absorb:
+                    # back off (no real sleep — windows drain per probe)
+                    backpressured += 1
+                    if backpressured > window_budget + len(plan.events) + 4:
+                        violations.append(
+                            "invariant G: disk pressure never cleared "
+                            f"after {backpressured} backoff rounds"
+                        )
+                        break
+                except sites.ChaosCrash:
+                    restarts += 1
+                    if restarts > len(plan.events) + 2:
+                        violations.append(
+                            f"restart loop: {restarts} restarts for "
+                            f"{len(plan.events)} planned events"
+                        )
+                        break
+        injected = list(rt.injected)
+    finally:
+        sites.deactivate()
+
+    rep = run_fsck(tmp)
+    for f in rep.corrupt:
+        violations.append(
+            f"invariant G/C: fsck {f.kind} at {f.path}: {f.detail}"
+        )
+    if disk_events and golden is not None:
+        for i in sorted(golden):
+            got = results.get(i)
+            if got is None:
+                violations.append(
+                    f"invariant G/A: spec {i} never reached a terminal "
+                    "state under disk pressure"
+                )
+                continue
+            if _canon(got) != _canon(golden[i]):
+                violations.append(
+                    f"invariant G/B: spec {i} diverged under disk "
+                    f"pressure (got {_canon(got)[:200]}...)"
+                )
+    if not keep_dir:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return TrialResult(plan=plan, violations=violations,
+                       injected=injected, restarts=restarts)
+
+
 # ---- the campaign --------------------------------------------------------
 
 
@@ -893,6 +1092,8 @@ def _trial_sites(classes) -> tuple[list, set]:
         names.extend(REPLICATION_SITES)
     if "silent_corruption" in classes:
         names.extend(ATTEST_SITES)
+    if "capacity_loss" in classes:
+        names.extend(CAPACITY_SITES)
     return names, socket_only
 
 
@@ -927,6 +1128,13 @@ def run_trial(plan, cfg=None, specs=DEFAULT_SPECS, golden=None,
         # invariant-B failure; corruption plans get the attested pool
         return run_attest_trial(plan, cfg=cfg, specs=specs,
                                 golden=golden, workdir=workdir, **kw)
+    if plan.events and any(
+        e.site in CAPACITY_SITES for e in plan.events
+    ):
+        # device revocation needs a sharded supervised engine and
+        # ENOSPC windows need a backpressure-aware client (invariant G)
+        return run_capacity_trial(plan, cfg=cfg, specs=specs,
+                                  golden=golden, workdir=workdir, **kw)
     if plan.events and all(
         sites.SITES.get(e.site) == "socket" for e in plan.events
     ):

@@ -29,6 +29,7 @@ ACTIONS = {
     "clock": ("skew",),
     "replication": ("partition", "delay", "duplicate"),
     "silent_corruption": ("flip",),
+    "capacity_loss": ("revoke", "enospc_window"),
 }
 
 # recv-side sockets can only lose or delay the reply — tearing or
@@ -128,6 +129,13 @@ def _event_args(rng: random.Random, action: str) -> tuple:
         return (("key", rng.randint(0, 7)),
                 ("pos", rng.randint(0, 1 << 16)),
                 ("delta", rng.choice((-3, -1, 1, 2, 5, 17)),))
+    if action == "revoke":
+        # how many devices drop out of the mesh at once
+        return (("n", rng.choice((1, 1, 2))),)
+    if action == "enospc_window":
+        # how many subsequent preflight probes see a full disk before
+        # the window "heals"
+        return (("calls", rng.randint(2, 6)),)
     return ()
 
 

@@ -63,6 +63,10 @@ SITES = {
     # wrong data, and only attestation cross-checks can tell
     "fleet.counters": "silent_corruption",      # sim/fleet.py post-drain
     "checkpoint.payload": "silent_corruption",  # element checkpoint arrays
+    # capacity-loss sites (DESIGN.md §26): a mesh shrinking under a live
+    # run, and a filesystem that stops taking bytes for a while
+    "devices.revoke": "capacity_loss",  # sim/supervisor.py chunk boundary
+    "disk.preflight": "capacity_loss",  # util/diskpressure.py space gate
 }
 
 ENV_PLAN = "PRIMETPU_CHAOS_PLAN"  # path to a FaultPlan JSON file
@@ -90,6 +94,10 @@ class ChaosRuntime:
         self.fired: set[int] = set()       # plan event indices consumed
         self.injected: list[dict] = []     # flight log for reports/tests
         self.clock_offsets: dict[str, float] = {}
+        # site -> remaining arrivals inside an open sustained window
+        # (enospc_window: the fault persists across several probes
+        # instead of firing once, like a disk that stays full)
+        self.windows: dict[str, int] = {}
 
     def hit(self, site: str):
         """Count one arrival at `site`; return the matching un-fired
@@ -314,6 +322,42 @@ def corrupt(site: str, arrays: dict) -> bool:
     delta = int(ev.arg("delta", 1)) or 1
     flat[int(ev.arg("pos", 0)) % flat.size] += delta
     return True
+
+
+def device_revoke(site: str):
+    """Capacity-loss site at a supervised chunk boundary: returns the
+    plan's `revoke` event (whose `n` arg says how many mesh devices
+    vanish) or None. The caller — the supervisor — enacts it via
+    `parallel.sharding.revoke_devices` and raises a synthetic
+    DEVICE_LOST, because only it knows which devices its mesh holds."""
+    if _RT is None:
+        return None
+    ev = _RT.hit(site)
+    if ev is not None and ev.action == "revoke":
+        return ev
+    return None
+
+
+def disk_full(site: str) -> bool:
+    """Sustained-ENOSPC site: True while a plan-opened window is live.
+
+    Unlike `durable`'s one-shot `enospc` (which models a crash), an
+    `enospc_window` event opens a window of `calls` consecutive arrivals
+    during which the probe reports a full disk and then heals — the shape
+    real disk pressure takes, and the one the diskpressure retry ladder
+    is built to ride out without losing ACKed state."""
+    if _RT is None:
+        return False
+    ev = _RT.hit(site)
+    if ev is not None and ev.action == "enospc_window":
+        _RT.windows[site] = (
+            _RT.windows.get(site, 0) + max(1, int(ev.arg("calls", 3)))
+        )
+    left = _RT.windows.get(site, 0)
+    if left > 0:
+        _RT.windows[site] = left - 1
+        return True
+    return False
 
 
 def wrap_clock(site: str, clock):

@@ -1789,6 +1789,14 @@ def _add_exec_flags(sp, overlap: bool = True) -> None:
              "the compiled program instead of paying trace+lower+XLA "
              "compile; corrupt/stale entries degrade to recompile",
     )
+    sp.add_argument(
+        "--cache-budget", type=int, default=None, metavar="BYTES",
+        help="shared byte budget for the governed artifact pool (warm-"
+             "state cache + AOT exec cache; DESIGN.md §26): LRU pruning "
+             "and the disk-pressure evict ladder both honor it; takes "
+             "precedence over $PRIMETPU_CACHE_MAX_BYTES (default: env "
+             "var, then 2 GiB)",
+    )
     if overlap:
         sp.add_argument(
             "--overlap", choices=("on", "off"), default="off",
@@ -1804,7 +1812,10 @@ def _activate_exec_cache(ns):
     resume and serve buckets consult `exec_cache.active()`, so one flag
     covers every compile site in the process)."""
     from ..sim import exec_cache
+    from ..util import diskpressure
 
+    if getattr(ns, "cache_budget", None) is not None:
+        diskpressure.configure(budget_bytes=ns.cache_budget)
     if getattr(ns, "exec_cache", "off") == "on":
         return exec_cache.configure(True)
     return exec_cache.configure(False)
@@ -2462,12 +2473,18 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument(
         "--classes", default="durable,crashpoint",
         help="comma list of fault classes to draw from: durable, "
-             "crashpoint, socket, replication, silent_corruption "
+             "crashpoint, socket, replication, silent_corruption, "
+             "capacity_loss "
              "(default durable,crashpoint; replication runs the primary+"
              "replicas+standby failover trial and implies replica-kill "
              "crashpoints; silent_corruption flips committed counter "
              "bits on a pooled attested campaign and checks that no "
-             "corrupted result reaches DONE unflagged)",
+             "corrupted result reaches DONE unflagged; capacity_loss "
+             "revokes devices from sharded supervised runs and opens "
+             "sustained-ENOSPC windows, checking invariant G — no ACKed "
+             "job lost, no bit-exactness violation; run it under "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=8 to "
+             "give revocation a real mesh to shrink)",
     )
     ch.add_argument(
         "--max-events", type=int, default=3,

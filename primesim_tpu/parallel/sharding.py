@@ -35,6 +35,33 @@ from ..sim.state import MachineState, TimingKnobs
 
 AXIS = "tiles"
 
+# Revoked-device registry (DESIGN.md §26). Real accelerators vanish from
+# the runtime on ICI/PCIe failure; virtual CPU meshes cannot, so device
+# loss is modeled the same way everywhere: a process-local set of device
+# ids that `healthy_devices()` filters out. Chaos `capacity_loss` trials
+# and the kill+shrink acceptance test populate it; on real hardware the
+# runtime's own device list shrinking has the identical effect because
+# `healthy_devices()` starts from `jax.devices()`.
+_REVOKED: set = set()
+
+
+def revoke_devices(ids) -> None:
+    """Mark device ids as lost (chaos injection / test hook)."""
+    _REVOKED.update(int(i) for i in ids)
+
+
+def restore_devices(ids=None) -> None:
+    """Heal revoked devices (all of them when `ids` is None)."""
+    if ids is None:
+        _REVOKED.clear()
+    else:
+        _REVOKED.difference_update(int(i) for i in ids)
+
+
+def healthy_devices() -> list:
+    """Currently-visible devices minus the revoked set."""
+    return [d for d in jax.devices() if d.id not in _REVOKED]
+
 
 class DeviceMeshError(ValueError):
     """Typed `--devices N` validation failure (CLI exit 2, structured
@@ -79,6 +106,23 @@ def validate_devices(cfg, n_devices: int) -> None:
                 devices=n_devices,
                 visible=visible,
             )
+
+
+def largest_valid_submesh(cfg, n_available: int) -> int:
+    """Largest mesh size <= `n_available` that shards this geometry
+    evenly (divides both n_cores and n_banks). n=1 always qualifies, so
+    any run with at least one healthy device has a valid landing mesh;
+    zero healthy devices is a hard DeviceMeshError."""
+    if n_available < 1:
+        raise DeviceMeshError(
+            "no healthy devices remain to host the mesh",
+            devices=0,
+            visible=n_available,
+        )
+    for n in range(int(n_available), 0, -1):
+        if cfg.n_cores % n == 0 and cfg.n_banks % n == 0:
+            return n
+    return 1
 
 
 def tile_mesh(n_devices: int | None = None, devices=None) -> Mesh:

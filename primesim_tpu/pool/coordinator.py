@@ -117,6 +117,9 @@ class PoolCoordinator:
             "attest_confirms": 0, "attest_mismatches": 0,
             "attest_incomparable": 0, "suspects": 0, "verdicts": 0,
             "audits": 0, "audits_ok": 0, "toolchain_refused": 0,
+            # degraded-mode elasticity (DESIGN.md §26): acks whose lease
+            # ran on a smaller mesh than requested after device loss
+            "capacity_degraded": 0,
         }
         if attest not in ("off", "chain"):
             from ..attest import AttestationError
@@ -606,6 +609,22 @@ class PoolCoordinator:
         self.counters["acks"] += 1
         self._pool_event("ack", unit=unit_id, worker=worker, epoch=epoch,
                          resumed_steps=resumed)
+        granted = (result or {}).get("detail", {}).get("devices_granted")
+        if granted:
+            # the worker re-leased onto a shrunken mesh (device loss):
+            # book the capacity change durably so a replayed coordinator
+            # and the campaign report both carry it
+            self.counters["capacity_degraded"] += 1
+            self.journal.append({
+                "t": "note", "kind": "capacity", "unit_id": unit_id,
+                "worker": worker,
+                "devices_requested": int(
+                    (result or {}).get("detail", {}).get("devices", 0)
+                ),
+                "devices_granted": int(granted),
+            })
+            self._pool_event("capacity_degraded", unit=unit_id,
+                             worker=worker, devices_granted=int(granted))
         if (not req.get("audit") and unit_id not in self.audits
                 and self._audit_due(u)):
             self.audits[unit_id] = {

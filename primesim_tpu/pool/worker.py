@@ -161,6 +161,7 @@ class PoolWorker:
         self.idle_exit_s = idle_exit_s
         self.units_done = 0
         self.units_lost = 0
+        self.units_degraded = 0  # leases re-granted on a smaller mesh
         self._chunks_seen = 0
         self._toolchain_cache = None
         # warm compiled fleets, one per geometry bucket: keyed by
@@ -315,14 +316,33 @@ class PoolWorker:
         """The device mesh a unit's `devices` field asks for (None for
         the default solo layout). Validation is typed so a bad mesh
         request quarantines with a structured error instead of a
-        mid-compile shape failure."""
+        mid-compile shape failure.
+
+        Degraded-mode elasticity (DESIGN.md §26): when fewer HEALTHY
+        devices remain than the lease asked for, the unit re-leases onto
+        the largest valid smaller mesh instead of quarantining — the
+        granted size is recorded on the unit (re-keying its geometry
+        bucket) and surfaced in the ack so the coordinator books the
+        capacity change. Sharded parity is mesh-invariant, so the result
+        is bit-exact either way."""
         devices = int(unit.get("devices") or 0)
         if not devices:
             return None
-        from ..parallel.sharding import tile_mesh, validate_devices
+        from ..parallel.sharding import (
+            healthy_devices,
+            largest_valid_submesh,
+            tile_mesh,
+            validate_devices,
+        )
 
-        validate_devices(cfg, devices)
-        return tile_mesh(devices)
+        healthy = healthy_devices()
+        if len(healthy) >= devices:
+            validate_devices(cfg, devices)  # geometry errors quarantine
+            return tile_mesh(devices=healthy[:devices])
+        n = largest_valid_submesh(cfg, len(healthy))  # raises at 0 healthy
+        unit["_granted_devices"] = n
+        self.units_degraded += 1
+        return tile_mesh(devices=healthy[:n])
 
     def _bucket_fleet(self, unit, cfg):
         """The warm compiled slot fleet for a unit's geometry bucket
@@ -334,13 +354,19 @@ class PoolWorker:
         from ..sim.fleet import FleetEngine
 
         cap = int(unit["capacity_pages"]) * PAGE_EVENTS
-        devices = int(unit.get("devices") or 0)
+        # the mesh resolves first: under capacity loss the GRANTED size
+        # keys the bucket, so degraded and full-size units never share a
+        # warm fleet compiled for the wrong layout
+        mesh = self._unit_mesh(unit, cfg)
+        devices = int(
+            unit.get("_granted_devices") or unit.get("devices") or 0
+        )
         key = (unit["config"], cap, int(unit["chunk_steps"]), devices)
         fleet = self._bucket_fleets.get(key)
         if fleet is None:
             fleet = FleetEngine.make_slots(
                 cfg, 1, cap, chunk_steps=int(unit["chunk_steps"]),
-                mesh=self._unit_mesh(unit, cfg),
+                mesh=mesh,
             )
             self._bucket_fleets[key] = fleet
         return fleet
@@ -456,7 +482,8 @@ class PoolWorker:
                         (unit["config"],
                          fleet.events_capacity,
                          int(unit["chunk_steps"]),
-                         int(unit.get("devices") or 0)), None)
+                         int(unit.get("_granted_devices")
+                             or unit.get("devices") or 0)), None)
             raise
         wall = time.perf_counter() - t0
 
@@ -483,6 +510,12 @@ class PoolWorker:
             # present ONLY for sharded campaigns, so unsharded sweep
             # records stay byte-identical for the pool-chaos CI diff
             result["detail"]["devices"] = int(unit["devices"])
+            if unit.get("_granted_devices"):
+                # capacity loss: the lease ran on a SMALLER mesh than it
+                # asked for — the coordinator books the change
+                result["detail"]["devices_granted"] = int(
+                    unit["_granted_devices"]
+                )
         if unit.get("serve_job"):
             # the front-end maps this into the serve job's result and
             # bit-exactness tests diff it against a solo Engine run —

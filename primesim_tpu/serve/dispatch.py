@@ -102,7 +102,9 @@ class DispatchScheduler:
 
         self.jobs: dict[str, J.Job] = {}
         self.queue: list[str] = []  # accepted, not yet enqueued remotely
-        self.dispatched: set[str] = set()  # enqueued, not yet terminal
+        self.dispatched: set[str] = set()  # unit ids enqueued, not terminal
+        self.unit_aliases: dict[str, str] = {}  # rebucketed unit id -> job id
+        self._rebucket_gen = 0
         self.buckets = []  # API parity: no local fleets in dispatch mode
         self._seq = 0
         self._last_poll_t = 0.0
@@ -184,7 +186,10 @@ class DispatchScheduler:
     def _unit_spec(self, job: J.Job) -> dict:
         jid = job.job_id
         spec = {
-            "unit_id": jid,
+            # a rebucketed job re-enqueues under a FRESH unit id: the
+            # coordinator's enqueue is idempotent per (id, key) and the
+            # key covers `devices`, so the shrunken bucket is a new unit
+            "unit_id": getattr(job, "_unit_alias", None) or jid,
             "index": int(jid[1:]) if jid[1:].isdigit() else 0,
             "config": self.cfg.to_json(),
             "trace_path": job.trace_path,
@@ -232,6 +237,9 @@ class DispatchScheduler:
         # an already-dispatched unit may still finish on a worker; its
         # late collect result is discarded because terminal is sticky
         self.dispatched.discard(job_id)
+        alias = getattr(job, "_unit_alias", None)
+        if alias:
+            self.dispatched.discard(alias)
         self._terminal(job, J.CANCELLED, detail={"detail": "client cancel"})
         return job
 
@@ -307,13 +315,12 @@ class DispatchScheduler:
         moved = False
         for job_id in list(self.queue):
             job = self.jobs[job_id]
-            reply = self._coord_request(
-                {"verb": "enqueue", "unit": self._unit_spec(job)}
-            )
+            spec = self._unit_spec(job)
+            reply = self._coord_request({"verb": "enqueue", "unit": spec})
             if reply is None:
                 break  # coordinator unreachable; retry next tick
             self.queue.remove(job_id)
-            self.dispatched.add(job_id)
+            self.dispatched.add(spec["unit_id"])
             moved = True
             if reply.get("state") in ("DONE", "POISON", "SUSPECT"):
                 # finished while we were down (front-end restart path)
@@ -363,7 +370,7 @@ class DispatchScheduler:
             return False
         moved = False
         for unit_id in reply.get("leased", ()):
-            job = self.jobs.get(unit_id)
+            job = self._job_for_unit(unit_id)
             if job is not None and job.state == J.PENDING:
                 job.attempts += 1
                 job.transition(J.RUNNING)
@@ -376,17 +383,28 @@ class DispatchScheduler:
                                   remote=True, attempt=job.attempts)
                 moved = True
         for fin in reply.get("finished", ()):
-            job = self.jobs.get(str(fin.get("unit_id")))
+            job = self._job_for_unit(str(fin.get("unit_id")))
             if job is None or job.terminal:
                 continue  # cancelled meanwhile, or unknown: drop
             self._finish_remote(job, fin)
             moved = True
         return moved
 
+    def _job_for_unit(self, unit_id: str) -> J.Job | None:
+        """Pool unit id -> serve job: identity for first-dispatch units,
+        via the alias map for rebucketed re-enqueues."""
+        job = self.jobs.get(unit_id)
+        if job is not None:
+            return job
+        return self.jobs.get(self.unit_aliases.get(unit_id, ""))
+
     def _finish_remote(self, job: J.Job, fin: dict) -> None:
         """Map a worker's unit outcome onto the serve job, producing the
         same result shape as `Scheduler._element_result`."""
         self.dispatched.discard(job.job_id)
+        alias = getattr(job, "_unit_alias", None)
+        if alias:
+            self.dispatched.discard(alias)
         if job.state == J.PENDING:
             # terminal transitions are only legal from RUNNING; the
             # lease happened while we weren't looking
@@ -432,6 +450,10 @@ class DispatchScheduler:
             )
             return
         if rec.get("metric") == "quarantined":
+            err = detail.get("error") or {}
+            if (err.get("type") == "DeviceMeshError"
+                    and self._rebucket_devices(job, err)):
+                return  # re-enqueued on a smaller geometry bucket
             self._terminal(
                 job, J.QUARANTINED,
                 detail=detail.get("error")
@@ -457,6 +479,46 @@ class DispatchScheduler:
         })
         self._serve_event("retire", job_id=job.job_id, state=J.DONE,
                           remote=True)
+
+    def _rebucket_devices(self, job: J.Job, err: dict) -> bool:
+        """Degraded-mode elasticity (DESIGN.md §26): a worker could not
+        host this job's mesh (devices revoked or too few visible), so the
+        unit came back quarantined with a DeviceMeshError. Instead of
+        quarantining the JOB, shrink the service's geometry bucket to the
+        largest mesh the reported capacity can host and re-enqueue under
+        a fresh unit id. False means the error is not recoverable this
+        way (no smaller valid mesh) and the caller quarantines as before."""
+        if not self.devices or self.devices <= 1:
+            return False
+        from ..parallel.sharding import DeviceMeshError, largest_valid_submesh
+
+        loc = err.get("location") or {}
+        try:
+            visible = int(loc.get("visible"))
+        except (TypeError, ValueError):
+            visible = self.devices - 1
+        try:
+            n = largest_valid_submesh(
+                self.cfg, min(visible, self.devices - 1)
+            )
+        except DeviceMeshError:
+            return False  # zero capacity reported: nothing to shrink to
+        if n < 1 or n >= self.devices:
+            return False
+        prev, self.devices = self.devices, n
+        self._rebucket_gen += 1
+        alias = f"{job.job_id}r{self._rebucket_gen}"
+        job._unit_alias = alias
+        self.unit_aliases[alias] = job.job_id
+        job.transition(J.PENDING)
+        self.queue.append(job.job_id)
+        self.journal.state(
+            job.job_id, J.PENDING,
+            detail={"rebucket": {"devices_from": prev, "devices_to": n}},
+        )
+        self._serve_event("rebucket", job_id=job.job_id,
+                          devices_from=prev, devices_to=n)
+        return True
 
     def _expire_deadlines(self, now: float) -> None:
         for job_id in list(self.queue):

@@ -67,6 +67,7 @@ import zlib
 
 from ..chaos import sites as chaos
 from ..obs.metrics import Histogram
+from ..util import diskpressure
 
 #: default active-segment record cap before a roll; None = never roll
 #: (the legacy single-file behavior)
@@ -172,6 +173,13 @@ class JobJournal:
         # the local fsync with the exact raw bytes on disk, so follower
         # chains stay byte-identical to this one
         self.sink = None
+        # disk-pressure ladder: compaction folds rolled segments into
+        # one base segment, the only space the journal may legally give
+        # back — ACKed state itself is never an eviction candidate
+        if self.compactor is not None:
+            diskpressure.register_compactor(
+                f"journal:{self.dir}", self.compact
+            )
 
     # ---- segment bookkeeping ---------------------------------------------
 
@@ -306,6 +314,11 @@ class JobJournal:
         t0 = time.perf_counter()
         line = _frame(rec)
         prev_crc = self._last_crc
+        # disk-pressure gate: on a full disk this evicts caches/rotated
+        # snapshots, compacts the journal, and raises DiskPressureError
+        # (admission backpressure) BEFORE the append half-lands — the
+        # record was not ACKed, so refusing it loses nothing
+        diskpressure.preflight(self.path, len(line) + 1, kind="journal")
         chaos.durable("journal.append", f=self._f, data=line + "\n")
         self._f.write(line + "\n")
         self._f.flush()
@@ -342,6 +355,7 @@ class JobJournal:
         self.append({"t": "drain"})
 
     def close(self) -> None:
+        diskpressure.unregister(f"journal:{self.dir}")
         try:
             self._f.close()
         except OSError:

@@ -900,17 +900,28 @@ class Scheduler:
         per-job path (atomic tmp+rename, so a crash mid-save leaves the
         previous checkpoint intact)."""
         from ..sim.checkpoint import save_element_checkpoint
+        from ..util.diskpressure import DiskPressureError
 
         for b in self.buckets:
             for i, job in enumerate(b.slots):
                 if job is not None:
-                    # fingerprint the FULL trace even for windowed
-                    # elements: recovery re-materializes the full trace
-                    # and must accept this snapshot
-                    save_element_checkpoint(
-                        self.job_ckpt_path(job.job_id), b.fleet, i,
-                        job_id=job.job_id, trace=job._trace,
-                    )
+                    try:
+                        # fingerprint the FULL trace even for windowed
+                        # elements: recovery re-materializes the full
+                        # trace and must accept this snapshot
+                        save_element_checkpoint(
+                            self.job_ckpt_path(job.job_id), b.fleet, i,
+                            job_id=job.job_id, trace=job._trace,
+                        )
+                    except DiskPressureError as e:
+                        # a skipped cadence checkpoint only widens this
+                        # job's recovery replay window; the job itself —
+                        # and every ACKed record — is untouched
+                        self._serve_event(
+                            "disk-pressure", job_id=job.job_id,
+                            detail=str(e),
+                        )
+                        continue
                     self._serve_event(
                         "checkpoint", job_id=job.job_id,
                         steps=int(b.fleet.steps_run[i]),

@@ -42,6 +42,7 @@ from .protocol import (
     parse_target,
     read_line,
 )
+from ..util.diskpressure import DiskPressureError
 from .quota import QuotaExceeded, TenantQuota
 from .replicate import PrimaryFenced, ReplicaQuorumLost
 from .scheduler import DEFAULT_BUCKETS, QueueFull, Scheduler
@@ -215,7 +216,8 @@ class PrimeServer:
                 self._draining = True
                 return {"ok": True, "draining": True}
             raise ValueError(f"unknown verb {verb!r}")
-        except (QueueFull, QuotaExceeded, ReplicaQuorumLost) as e:
+        except (QueueFull, QuotaExceeded, ReplicaQuorumLost,
+                DiskPressureError) as e:
             out = {"ok": False, "retry_after_s": round(e.retry_after_s, 1)}
             out.update(error_obj(e))
             return out

@@ -35,6 +35,7 @@ from ..chaos import sites as chaos
 from ..config.machine import MachineConfig
 from ..faults.schedule import FaultState
 from ..stats.counters import COUNTER_NAMES
+from ..util import diskpressure
 from .state import MachineState, TimingKnobs
 
 _FORMAT = 7  # v3: fused dirm row (metadata + sharers) replaces
@@ -92,6 +93,15 @@ def atomic_save_npz(path: str, **arrays) -> None:
     }
     named[_CRC_KEY] = np.frombuffer(
         json.dumps(crcs, sort_keys=True).encode(), dtype=np.uint8
+    )
+    # disk-pressure gate BEFORE any byte lands: uncompressed total is a
+    # conservative ceiling on the compressed npz. On pressure this runs
+    # the evict->compact ladder and raises DiskPressureError rather than
+    # letting savez die mid-write with an ENOSPC-torn temp file
+    diskpressure.preflight(
+        path,
+        sum(v.nbytes for v in named.values()),
+        kind="checkpoint",
     )
     # the temp name must be unique PER WRITER, not per destination: a
     # hedged pool pair checkpoints the same unit path from two processes
@@ -858,7 +868,13 @@ def prune_warm_cache(root: str, max_bytes: int | None = None) -> int:
     The budget is SHARED with the executable cache (§23): warm `.npz`
     entries in `root` and AOT `.bin` entries in `root/exec` form one
     LRU pool, so a burst of geometry sweeps can evict stale executables
-    and vice versa — one knob bounds the whole cache tree."""
+    and vice versa — one knob bounds the whole cache tree.
+
+    Budget resolution order: explicit `max_bytes` arg > the process-wide
+    `--cache-budget` value (util.diskpressure.budget()) >
+    $PRIMETPU_CACHE_MAX_BYTES > the 2 GiB default."""
+    if max_bytes is None:
+        max_bytes = diskpressure.budget()
     if max_bytes is None:
         max_bytes = int(
             os.environ.get("PRIMETPU_CACHE_MAX_BYTES", _WARM_DEFAULT_MAX_BYTES)

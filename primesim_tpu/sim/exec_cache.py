@@ -299,6 +299,12 @@ class ExecCache:
         prune_warm_cache(os.path.dirname(self.root))
 
     def _atomic_write(self, dst: str, data: bytes) -> None:
+        # disk-pressure gate: a DiskPressureError here unwinds into the
+        # _write_entry caller's fallback — a cache entry that cannot be
+        # persisted costs a recompile, never the run
+        from ..util import diskpressure
+
+        diskpressure.preflight(dst, len(data), kind="exec-cache")
         # writer-unique temp name: concurrent processes warming the same
         # entry must not rename each other's file away mid-write
         fd, tmp = tempfile.mkstemp(

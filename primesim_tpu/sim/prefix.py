@@ -261,9 +261,17 @@ def execute_prefix_plan(
             }
             stats["prefix_wall_s"] += time.perf_counter() - t0
             if root is not None:
-                save_warm_state(root, rcfg, fp, g.prefix_steps, snap)
-                if obs is not None:
-                    obs.prefix_event("warm-store", key=g.cache_key, steps=g.prefix_steps)
+                from ..util.diskpressure import DiskPressureError
+
+                try:
+                    save_warm_state(root, rcfg, fp, g.prefix_steps, snap)
+                except DiskPressureError:
+                    # the warm entry is an optimization; under disk
+                    # pressure the fork still happens from live state
+                    pass
+                else:
+                    if obs is not None:
+                        obs.prefix_event("warm-store", key=g.cache_key, steps=g.prefix_steps)
         for i in g.indices:
             fleet.fork_element(i, snap, cache_key=g.cache_key)
         stats["forked_elements"] += len(g.indices)

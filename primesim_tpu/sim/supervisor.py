@@ -45,13 +45,16 @@ chunk by committed chunk with:
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import signal
+import sys
 import time
 
 import numpy as np
 
+from ..chaos import sites as chaos_sites
 from ..stats.counters import COUNTER_NAMES
 from .checkpoint import CheckpointCorrupt
 from .validate import check_chunk_invariants
@@ -88,17 +91,37 @@ _TRANSIENT_MARKERS = (
     "CANCELLED",
     "failed to connect",
     "Socket closed",
+    # typed admission backpressure from util/diskpressure — the window
+    # heals; back off and retry rather than kill the run
+    "DiskPressureError",
+)
+# a device dropping out of the mesh: the runtime's own phrasing on real
+# hardware, the typed mesh validator, and the chaos-injected synthetic
+_DEVICE_LOSS_MARKERS = (
+    "DEVICE_LOST",
+    "device lost",
+    "Device lost",
+    "device unhealthy",
+    "DeviceMeshError",
+    "chip unreachable",
+    "heartbeat timeout on device",
 )
 
 
 def classify_failure(exc: BaseException) -> str | None:
-    """'oom' | 'transient' | None (permanent) for an engine dispatch
-    failure. Deliberate errors (ValueError config/trace mismatches,
-    AssertionError invariants, KeyboardInterrupt) are never retried."""
-    if isinstance(exc, (KeyboardInterrupt, SystemExit, AssertionError,
-                        ValueError)):
+    """'device_loss' | 'oom' | 'transient' | None (permanent) for an
+    engine dispatch failure. Deliberate errors (ValueError config/trace
+    mismatches, AssertionError invariants, KeyboardInterrupt) are never
+    retried — but device loss is checked FIRST, because the typed
+    DeviceMeshError a vanished mesh raises is a ValueError, and it is
+    precisely the recoverable case the reshard ladder exists for."""
+    if isinstance(exc, (KeyboardInterrupt, SystemExit)):
         return None
     text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _DEVICE_LOSS_MARKERS):
+        return "device_loss"
+    if isinstance(exc, (AssertionError, ValueError)):
+        return None
     if any(m in text for m in _OOM_MARKERS):
         return "oom"
     if any(m in text for m in _TRANSIENT_MARKERS):
@@ -157,6 +180,24 @@ class SnapshotStore:
         self.dir = str(directory)
         self.keep = max(1, int(keep))
         os.makedirs(self.dir, exist_ok=True)
+        # disk-pressure rung 1 (after caches, before backpressure):
+        # rotated snapshots are droppable down to the newest one — the
+        # resume anchor itself is never evicted
+        from ..util import diskpressure
+
+        diskpressure.register_evictor(
+            f"snapshots:{self.dir}", self._evict_rotated, priority=1
+        )
+
+    def _evict_rotated(self, need_bytes: int) -> int:
+        removed = 0
+        for p in self.snapshots()[1:]:
+            try:
+                os.unlink(p)
+                removed += 1
+            except OSError:
+                pass
+        return removed
 
     def snapshots(self) -> list[str]:
         """Snapshot paths, newest (highest sequence) first."""
@@ -250,6 +291,9 @@ class RunSupervisor:
         self._prev_totals: dict[str, int] | None = None
         self._cpu_fallback_done = False
         self._stream_finished = False
+        # which device-loss ladder rungs fired, in order ("reshard:8->4",
+        # "cpu-fallback") — surfaced in summary() and the RESILIENCE log
+        self.degrade_rungs: list[str] = []
         # chaos mode (DESIGN.md §12): when the wrapped engine's config
         # arms fault injection, the supervisor narrates every fault the
         # machine absorbs into the RESILIENCE audit trail
@@ -280,15 +324,26 @@ class RunSupervisor:
             "guard": self.guard,
             "guard_warnings": self.guard_warnings,
             "stalled_elements": self.stalled_elements,
+            "degrade_rungs": list(self.degrade_rungs),
         }
 
     # ---- snapshots ------------------------------------------------------
 
     def checkpoint(self) -> str | None:
-        """Write the next rotating snapshot (None without a store)."""
+        """Write the next rotating snapshot (None without a store).
+
+        Disk pressure that survives the whole evict+compact ladder skips
+        THIS rotation instead of killing the run — a wider resume window
+        is strictly better than no run at all."""
         if self.store is None:
             return None
-        path = self.store.save(self.engine.save_checkpoint)
+        from ..util.diskpressure import DiskPressureError
+
+        try:
+            path = self.store.save(self.engine.save_checkpoint)
+        except DiskPressureError as e:
+            self._log("disk-pressure", f"snapshot skipped: {e}")
+            return None
         self.checkpoints_written += 1
         self._log("checkpoint", os.path.basename(path))
         return path
@@ -417,10 +472,42 @@ class RunSupervisor:
         # away from; the identity check would reject it, this frees it
         getattr(eng, "discard_prefetch", lambda: None)()
 
+    def _chaos_revoke_check(self) -> None:
+        """Chaos `capacity_loss` site: at a chunk boundary, revoke
+        device(s) from the live pool and raise the synthetic DEVICE_LOST
+        the reshard ladder classifies. Enacted here (not inside the
+        hook) because only the supervisor knows which devices its
+        engine's mesh holds."""
+        ev = chaos_sites.device_revoke("devices.revoke")
+        if ev is None:
+            return
+        from ..parallel import sharding
+
+        mesh = getattr(self.engine, "mesh", None)
+        healthy_ids = {d.id for d in sharding.healthy_devices()}
+        pool = [
+            d
+            for d in (
+                list(mesh.devices.flat)
+                if mesh is not None
+                else sharding.healthy_devices()
+            )
+            if d.id in healthy_ids
+        ]
+        n = min(int(ev.arg("n", 1)), len(pool) - 1)
+        if n < 1:
+            return  # a single-device run has nothing left to lose
+        victims = [d.id for d in pool[-n:]]
+        sharding.revoke_devices(victims)
+        raise RuntimeError(
+            f"DEVICE_LOST: injected revocation of device id(s) {victims}"
+        )
+
     def _advance_chunk(self, budget_left: int) -> int:
         """Advance the engine by one committed chunk; returns steps run
         (stream reports the device loop's count; solo/fleet report their
         chunk size)."""
+        self._chaos_revoke_check()
         if self.kind == "stream":
             k, finished = self.engine._advance_window(budget_left)
             self._stream_finished = finished
@@ -431,35 +518,155 @@ class RunSupervisor:
 
     # ---- retry / degradation --------------------------------------------
 
-    def _fallback_to_cpu(self, cause: BaseException) -> bool:
-        """Last-resort degradation: move the run to the CPU backend.
-        Returns False when impossible (already on CPU, mesh-sharded, or
-        no CPU devices) — the caller then re-raises the original."""
+    def _fallback_to_cpu(self, cause: BaseException,
+                         unshard: bool = False) -> bool:
+        """Last-resort degradation: move the run to a single (CPU)
+        device. Returns False when impossible (already fell back, no
+        landing device) — the caller then re-raises the original.
+
+        Mesh-sharded engines are refused UNLESS `unshard=True`: on the
+        device-loss ladder this is the final rung, entered only after
+        resharding onto a smaller mesh has already failed, and it
+        collapses the run onto one healthy device (`engine.mesh = None`;
+        parity is mesh-invariant, so results are unchanged)."""
         import jax
+
+        from ..parallel import sharding
 
         if self._cpu_fallback_done:
             return False
-        if getattr(self.engine, "mesh", None) is not None:
+        mesh = getattr(self.engine, "mesh", None)
+        if mesh is not None and not unshard:
             self._log(
                 "degrade", "cannot fall back to CPU: engine is mesh-sharded"
             )
             return False
-        if jax.default_backend() == "cpu":
+        if mesh is None and jax.default_backend() == "cpu":
             return False
+        healthy_ids = {d.id for d in sharding.healthy_devices()}
         try:
-            cpu = jax.devices("cpu")[0]
+            cpus = [d for d in jax.devices("cpu") if d.id in healthy_ids]
         except RuntimeError:
+            cpus = []
+        if cpus:
+            target = cpus[0]
+        elif unshard and mesh is not None and healthy_ids:
+            target = sharding.healthy_devices()[0]
+        else:
             return False
-        self._log("degrade", f"moving run to CPU backend after: {cause}")
-        jax.config.update("jax_default_device", cpu)
+        if mesh is not None:
+            self.engine.mesh = None
+            self._log(
+                "degrade",
+                f"device-loss final rung: unsharding onto single device "
+                f"{target.id} after: {cause}",
+            )
+        else:
+            self._log("degrade", f"moving run to CPU backend after: {cause}")
+        jax.config.update("jax_default_device", target)
         for attr in ("events", "state"):
             if hasattr(self.engine, attr):
                 setattr(
                     self.engine,
                     attr,
-                    jax.device_put(getattr(self.engine, attr), cpu),
+                    jax.device_put(getattr(self.engine, attr), target),
                 )
+        getattr(self.engine, "discard_prefetch", lambda: None)()
         self._cpu_fallback_done = True
+        return True
+
+    def _reshard_after_device_loss(self, cause: BaseException) -> bool:
+        """First rung of the device-loss ladder: shrink the mesh onto
+        the remaining healthy devices and re-place the run there.
+
+        Prefers re-placing the newest verified snapshot through the
+        existing cross-mesh loader path (checkpoint loaders re-shard
+        restored state onto `engine.mesh` — re-running from a committed
+        boundary is deterministic, so the continuation stays bit-exact);
+        with no usable snapshot the live host-visible arrays are
+        re-sharded in place. Returns False when there is no mesh to
+        shrink, no healthy landing mesh exists, or the healthy set did
+        not actually change (so retries cannot loop through here)."""
+        from ..parallel import sharding
+
+        mesh = getattr(self.engine, "mesh", None)
+        if mesh is None or self.kind == "stream":
+            # stream engines re-fill device windows from host cursors;
+            # their recovery story is resume-from-snapshot, not live
+            # surgery — let the next rung (or the caller) handle it
+            return False
+        healthy = sharding.healthy_devices()
+        healthy_ids = {d.id for d in healthy}
+        cur = list(mesh.devices.flat)
+        lost = [d.id for d in cur if d.id not in healthy_ids]
+        if not lost and len(healthy) >= len(cur):
+            return False  # every mesh device still answers
+        try:
+            n = sharding.largest_valid_submesh(self.engine.cfg, len(healthy))
+        except sharding.DeviceMeshError as e:
+            self._log("degrade", f"device loss: no landing mesh ({e})")
+            return False
+        if n >= len(cur) and not lost:
+            return False
+        new_mesh = sharding.tile_mesh(devices=healthy[:n])
+        self.engine.mesh = new_mesh
+        restored = None
+        if self.store is not None:
+            for path in self.store.snapshots():
+                try:
+                    self.engine.load_checkpoint(path)
+                except (CheckpointCorrupt, ValueError, OSError) as e:
+                    self._log(
+                        "resume-skip",
+                        f"{os.path.basename(path)} unusable during "
+                        f"reshard, trying older ({e})",
+                    )
+                    continue
+                restored = path
+                break
+        # re-place whatever the loader didn't cover: events always ride
+        # outside snapshots; state too when nothing was restorable (the
+        # old buffers stay readable — virtual meshes never physically
+        # lose devices, and on hardware the snapshot path above is the
+        # one that fires)
+        if self.kind == "fleet":
+            self.engine._reshard()
+        else:
+            self.engine.events = sharding.shard_events(
+                new_mesh, self.engine.events
+            )
+            if restored is None:
+                self.engine.state = sharding.shard_state(
+                    new_mesh, self.engine.state
+                )
+        getattr(self.engine, "discard_prefetch", lambda: None)()
+        rung = f"reshard:{len(cur)}->{n}"
+        self.degrade_rungs.append(rung)
+        self._log(
+            "degrade",
+            f"device loss ({cause}): mesh {len(cur)} -> {n} device(s)"
+            + (
+                f", re-placed {os.path.basename(restored)}"
+                if restored
+                else ", re-placed live state"
+            ),
+        )
+        print(
+            json.dumps(
+                {
+                    "event": "degraded",
+                    "reason": "device_loss",
+                    "lost_devices": lost,
+                    "from_devices": len(cur),
+                    "to_devices": n,
+                    "restored": (
+                        os.path.basename(restored) if restored else None
+                    ),
+                }
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
         return True
 
     def _advance_with_retry(self, budget_left: int) -> int:
@@ -479,6 +686,20 @@ class RunSupervisor:
                 kind = classify_failure(e)
                 if kind is None:
                     raise
+                if kind == "device_loss":
+                    # the device-loss ladder, in order: shrink the mesh
+                    # onto healthy devices; only when no landing mesh
+                    # exists, collapse onto a single (CPU) device; only
+                    # then give up. Each rung logs itself.
+                    if self._reshard_after_device_loss(e):
+                        continue
+                    if self._fallback_to_cpu(e, unshard=True):
+                        self.degrade_rungs.append("cpu-fallback")
+                        continue
+                    # nothing to demote (already unsharded on the only
+                    # healthy device): indistinguishable from a transient
+                    # blip — take the bounded backoff-retry path below
+                    kind = "transient"
                 if attempt >= self.max_retries:
                     if self._fallback_to_cpu(e):
                         continue  # one full attempt on the CPU backend

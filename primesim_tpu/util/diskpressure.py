@@ -1,0 +1,193 @@
+"""Unified disk-pressure governance (DESIGN.md §26).
+
+Every durable surface in the stack — the exec cache, the warm-state
+cache, rotating checkpoint snapshots, journal segments — historically
+assumed infinite disk: an ENOSPC anywhere was an unhandled OSError that
+killed the process mid-write. This module is the single byte-budget
+authority they all consult:
+
+- **one budget** (`configure(budget_bytes=...)`) bounds the governed
+  artifact pool; `checkpoint.prune_warm_cache` reads it first, before
+  the `PRIMETPU_CACHE_MAX_BYTES` env var, so `--cache-budget` is one
+  knob over the whole warm+exec cache tree;
+- **preflight** (`preflight(path, need_bytes, kind)`) is called inside
+  `checkpoint.atomic_save_npz` and `journal.JobJournal.append` BEFORE
+  bytes hit the disk. When free space (or a chaos-injected ENOSPC
+  window) cannot cover the write, it runs the retry ladder;
+- **the ladder** is priority-ordered eviction — registered evictors run
+  cheapest-to-recreate first (caches at priority 0, rotated snapshots
+  at priority 1; ACKed journal state is NEVER an evictor) — then
+  registered compactors (journal snapshot+truncate), and only when both
+  fail does it raise the typed `DiskPressureError` carrying a
+  `retry_after_s` hint, which the serve protocol surfaces as admission
+  backpressure exactly like `QueueFull`/`ReplicaQuorumLost`. Disk-full
+  degrades service; it does not crash it.
+
+The chaos `capacity_loss` class drives the `disk.preflight` site
+(sites.disk_full): a plan event opens a sustained window during which
+preflight sees zero free bytes no matter what the real filesystem says,
+so the ladder — and the no-ACKed-job-lost invariant G — is exercised on
+a healthy container.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from ..chaos import sites as chaos
+
+#: free-bytes floor kept on the filesystem beyond the write itself —
+#: a write that would leave less than this headroom is treated as
+#: pressure even before the kernel says ENOSPC
+DEFAULT_HEADROOM_BYTES = 8 << 20
+
+_BUDGET: int | None = None
+_HEADROOM: int = DEFAULT_HEADROOM_BYTES
+
+# name -> (priority, fn); fn(need_bytes) -> freed bytes (best effort,
+# may return 0 — the ladder rechecks real free space after every rung)
+_EVICTORS: dict[str, tuple[int, object]] = {}
+# name -> fn; fn() -> None (journal compaction and friends)
+_COMPACTORS: dict[str, object] = {}
+
+_IN_LADDER = False  # reentrancy guard: ladder work may itself write
+
+stats = {
+    "preflights": 0,
+    "pressure_events": 0,
+    "evictions_run": 0,
+    "compactions_run": 0,
+    "rejections": 0,
+}
+
+
+class DiskPressureError(OSError):
+    """Typed admission backpressure for a disk that stayed full after
+    the whole evict -> compact ladder ran. Carries the `retry_after_s`
+    hint the serve protocol returns to clients (the same shape as
+    `QueueFull`/`ReplicaQuorumLost`), so a full disk sheds load instead
+    of killing the daemon."""
+
+    def __init__(self, detail: str, *, path: str | None = None,
+                 need_bytes: int = 0, retry_after_s: float = 2.0):
+        super().__init__(detail)
+        self.path = path
+        self.need_bytes = int(need_bytes)
+        self.retry_after_s = float(retry_after_s)
+
+    def location(self) -> dict:
+        loc: dict = {"need_bytes": self.need_bytes}
+        if self.path is not None:
+            loc["path"] = self.path
+        return loc
+
+
+def configure(budget_bytes: int | None = None,
+              headroom_bytes: int | None = None) -> None:
+    """Set the process-wide governed byte budget (None = env/default)
+    and optionally the free-space headroom floor."""
+    global _BUDGET, _HEADROOM
+    _BUDGET = int(budget_bytes) if budget_bytes is not None else None
+    if headroom_bytes is not None:
+        _HEADROOM = int(headroom_bytes)
+
+
+def budget() -> int | None:
+    """The configured shared cache/artifact byte budget (None when only
+    the env var / built-in default applies)."""
+    return _BUDGET
+
+
+def register_evictor(name: str, fn, priority: int = 0) -> None:
+    """Register a pressure evictor. Priority 0 = re-derivable caches
+    (evicted first), 1 = rotated snapshots (never the newest). Re-using
+    a name replaces the previous registration (per-directory stores
+    re-register on construction)."""
+    _EVICTORS[name] = (int(priority), fn)
+
+
+def register_compactor(name: str, fn) -> None:
+    """Register a compaction step (runs after every evictor)."""
+    _COMPACTORS[name] = fn
+
+
+def unregister(name: str) -> None:
+    _EVICTORS.pop(name, None)
+    _COMPACTORS.pop(name, None)
+
+
+def free_bytes(path: str) -> int:
+    """Free bytes on `path`'s filesystem, as the ladder should see them:
+    zero while a chaos ENOSPC window (`disk.preflight` site) is open."""
+    if chaos.disk_full("disk.preflight"):
+        return 0
+    probe = path if os.path.isdir(path) else (os.path.dirname(path) or ".")
+    try:
+        return int(shutil.disk_usage(probe).free)
+    except OSError:
+        # an unstattable target will fail at write time with a better
+        # error than anything preflight could synthesize
+        return 1 << 62
+
+
+def _default_cache_evictor(need_bytes: int) -> int:
+    """The always-present priority-0 rung: drop the shared warm+exec
+    LRU pool (re-derivable by construction — a cold cache only costs
+    recompute). Lazy import: checkpoint.py imports this module."""
+    from ..sim.checkpoint import prune_warm_cache, warm_cache_root
+
+    root = warm_cache_root()
+    removed = prune_warm_cache(root, max_bytes=0)
+    return removed  # entry count; caller rechecks real free space
+
+
+def preflight(path: str, need_bytes: int, kind: str = "artifact") -> None:
+    """Free-space gate called before a durable write of ~`need_bytes`
+    to `path`. Returns normally when the write can proceed; otherwise
+    runs the evict -> compact ladder and, if the disk is still full,
+    raises `DiskPressureError` with a `retry_after_s` backpressure hint.
+
+    Reentrant calls (ladder work writing its own records) pass straight
+    through — the outer preflight already owns the ladder."""
+    global _IN_LADDER
+    if _IN_LADDER:
+        return
+    stats["preflights"] += 1
+    need = int(need_bytes) + _HEADROOM
+    if free_bytes(path) >= need:
+        return
+    stats["pressure_events"] += 1
+    _IN_LADDER = True
+    try:
+        rungs = sorted(
+            [(prio, name, fn) for name, (prio, fn) in _EVICTORS.items()]
+            + [(0, "cache-lru", _default_cache_evictor)],
+        )
+        for _prio, name, fn in rungs:
+            try:
+                fn(need)
+            except Exception:  # noqa: BLE001 — eviction is best-effort
+                pass
+            stats["evictions_run"] += 1
+            if free_bytes(path) >= need:
+                return
+        for name in sorted(_COMPACTORS):
+            try:
+                _COMPACTORS[name]()
+            except Exception:  # noqa: BLE001 — compaction is best-effort
+                pass
+            stats["compactions_run"] += 1
+            if free_bytes(path) >= need:
+                return
+    finally:
+        _IN_LADDER = False
+    stats["rejections"] += 1
+    raise DiskPressureError(
+        f"disk pressure: {kind} write of ~{int(need_bytes)} bytes to "
+        f"{path} cannot proceed ({free_bytes(path)} free after "
+        "evict+compact ladder); retry after backpressure window",
+        path=path,
+        need_bytes=int(need_bytes),
+        retry_after_s=2.0,
+    )
